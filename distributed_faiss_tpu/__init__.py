@@ -1,0 +1,43 @@
+"""distributed_faiss_tpu — a TPU-native distributed ANN search framework.
+
+A from-scratch rebuild of the *capabilities* of facebookresearch/distributed-faiss
+(reference layout: distributed_faiss/{client,server,index,rpc,index_cfg,index_state}.py)
+on a JAX/XLA/Pallas compute substrate:
+
+- N isolated index-server processes, each owning a corpus shard resident in TPU HBM
+  (reference: CPU-FAISS shards, distributed_faiss/server.py:38-45).
+- All coordination is client-side: round-robin placement, fan-out search, top-k merge,
+  state aggregation (reference: distributed_faiss/client.py:57-345).
+- All distance / k-means / PQ / SQ math is jitted XLA (MXU matmuls) with a Pallas
+  kernel for the PQ asymmetric-distance (ADC) scan, replacing the FAISS C++ surface
+  (reference: faiss.* usage in distributed_faiss/index.py:25-100).
+- Within a server, the corpus can be sharded over a multi-chip ``jax.sharding.Mesh``
+  with XLA collectives over ICI (the reference has no intra-server parallelism beyond
+  FAISS OpenMP threads).
+
+Public API mirrors the reference's external surface:
+``IndexClient``, ``IndexServer``, ``IndexCfg``, ``IndexState``.
+
+Imports are lazy (PEP 562) so kernel-only use doesn't pull in the server/RPC stack.
+"""
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "IndexCfg": ("distributed_faiss_tpu.utils.config", "IndexCfg"),
+    "IndexState": ("distributed_faiss_tpu.utils.state", "IndexState"),
+    "Index": ("distributed_faiss_tpu.engine", "Index"),
+    "IndexServer": ("distributed_faiss_tpu.parallel.server", "IndexServer"),
+    "IndexClient": ("distributed_faiss_tpu.parallel.client", "IndexClient"),
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,3 @@
+from distributed_faiss_tpu.ops import distance, kmeans, pq, sq
+
+__all__ = ["distance", "kmeans", "pq", "sq"]

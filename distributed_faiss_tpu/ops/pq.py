@@ -1,0 +1,105 @@
+"""Product quantization: codebook training, encode/decode, ADC scan.
+
+Replaces FAISS's ``IndexIVFPQ`` native surface (the ``knnlm`` builder at
+distributed_faiss/index.py:43-48: m=code_size subvectors, 8-bit codebooks,
+asymmetric distance computation via lookup tables).
+
+TPU-first structure:
+- Codebook training is ``kmeans_batched`` — all m subspace clusterings run
+  as one vmapped XLA program (batched MXU matmuls), not m sequential loops.
+- Encode is a batched argmin over (n, m, ksub) distance blocks.
+- The ADC scan builds a per-query LUT (m, ksub) and accumulates
+  ``sum_m lut[m, code[m]]`` with ``take_along_axis``; the Pallas kernel in
+  ``adc_pallas.py`` implements the same contract with explicit VMEM tiling
+  for the TPU hot path.
+
+Scores follow the ops-wide bigger-is-better convention:
+l2 -> negated squared distance contributions, dot -> inner products.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from distributed_faiss_tpu.ops.kmeans import kmeans_batched
+
+
+def _split(x, m: int):
+    """(n, d) -> (m, n, dsub)."""
+    n, d = x.shape
+    if d % m != 0:
+        raise ValueError(f"dim {d} not divisible by m={m}")
+    return jnp.transpose(x.reshape(n, m, d // m), (1, 0, 2))
+
+
+def pq_train(x, m: int, nbits: int = 8, iters: int = 20, seed: int = 0):
+    """Train per-subspace codebooks. x: (n, d) -> (m, ksub, dsub) fp32."""
+    ksub = 1 << nbits
+    return kmeans_batched(_split(jnp.asarray(x, jnp.float32), m), ksub, iters=iters, seed=seed)
+
+
+@jax.jit
+def pq_encode(x, codebooks):
+    """x: (n, d), codebooks: (m, ksub, dsub) -> codes (n, m) uint8."""
+    m = codebooks.shape[0]
+    xs = _split(jnp.asarray(x, jnp.float32), m)  # (m, n, dsub)
+    cn = jnp.sum(codebooks * codebooks, axis=2)  # (m, ksub)
+    ip = jnp.einsum("mnd,mkd->mnk", xs, codebooks, precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32)
+    d2 = cn[:, None, :] - 2.0 * ip  # ||x||^2 constant per row — argmin-invariant
+    return jnp.argmin(d2, axis=2).T.astype(jnp.uint8)  # (n, m)
+
+
+@jax.jit
+def pq_decode(codes, codebooks):
+    """codes: (n, m) uint8 -> (n, d) fp32 reconstruction."""
+    m, ksub, dsub = codebooks.shape
+    gathered = jnp.take_along_axis(
+        codebooks[:, None, :, :],  # (m, 1, ksub, dsub)
+        codes.T[:, :, None, None].astype(jnp.int32),  # (m, n, 1, 1)
+        axis=2,
+    )[:, :, 0, :]  # (m, n, dsub)
+    return jnp.transpose(gathered, (1, 0, 2)).reshape(codes.shape[0], m * dsub)
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def adc_lut(q, codebooks, metric: str = "l2"):
+    """Per-query ADC lookup tables.
+
+    q: (nq, d), codebooks: (m, ksub, dsub) -> lut (nq, m, ksub) fp32 where
+    score(query, code) = sum_m lut[q, m, code[m]] (bigger is better).
+    """
+    m = codebooks.shape[0]
+    qs = _split(jnp.asarray(q, jnp.float32), m)  # (m, nq, dsub)
+    ip = jnp.einsum("mnd,mkd->nmk", qs, codebooks, precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32)
+    if metric == "dot":
+        return ip
+    qn = jnp.sum(qs * qs, axis=2).T  # (nq, m)
+    cn = jnp.sum(codebooks * codebooks, axis=2)  # (m, ksub)
+    return -(qn[:, :, None] - 2.0 * ip + cn[None, :, :])
+
+
+@jax.jit
+def adc_scan(lut, codes):
+    """Accumulate LUT entries over codes.
+
+    lut: (nq, m, ksub); codes: (nq, L, m) uint8 (per-query candidate lists)
+    -> scores (nq, L) fp32.
+    """
+    idx = jnp.transpose(codes.astype(jnp.int32), (0, 2, 1))  # (nq, m, L)
+    vals = jnp.take_along_axis(lut, idx, axis=2)  # (nq, m, L)
+    return jnp.sum(vals, axis=1)
+
+
+@jax.jit
+def adc_scan_shared(lut, codes):
+    """ADC scan against one shared candidate list.
+
+    lut: (nq, m, ksub); codes: (L, m) uint8 -> scores (nq, L) fp32.
+    """
+    onehot_free = jnp.take_along_axis(
+        jnp.broadcast_to(lut[:, :, :], lut.shape),
+        jnp.broadcast_to(codes.T[None, :, :].astype(jnp.int32), (lut.shape[0],) + codes.T.shape),
+        axis=2,
+    )
+    return jnp.sum(onehot_free, axis=1)

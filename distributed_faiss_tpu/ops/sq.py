@@ -1,0 +1,45 @@
+"""Scalar quantization codecs.
+
+Replaces FAISS's ``ScalarQuantizer`` surface (QT_8bit inside HNSW at
+distributed_faiss/index.py:55, QT_fp16 inside IVF-SQ at
+distributed_faiss/index.py:63-68).
+
+- int8 ("sq8"): per-dimension affine codec. Train learns per-dim (min, span);
+  encode maps to uint8 on a 255-step grid; decode reconstructs the grid point.
+- fp16: plain dtype narrowing (decode-on-the-fly in distance kernels is just
+  an astype that XLA fuses into the matmul).
+
+All codecs are pure jitted functions so they fuse into surrounding scans.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def sq8_train(x):
+    """Learn per-dim affine range. x: (n, d) -> dict of (d,) fp32 arrays."""
+    x = jnp.asarray(x, jnp.float32)
+    vmin = jnp.min(x, axis=0)
+    vmax = jnp.max(x, axis=0)
+    span = jnp.maximum(vmax - vmin, 1e-12)
+    return {"vmin": vmin, "span": span}
+
+
+@jax.jit
+def sq8_encode(x, vmin, span):
+    x = jnp.asarray(x, jnp.float32)
+    q = jnp.round((x - vmin[None, :]) / span[None, :] * 255.0)
+    return jnp.clip(q, 0, 255).astype(jnp.uint8)
+
+
+@jax.jit
+def sq8_decode(codes, vmin, span):
+    return vmin[None, :] + codes.astype(jnp.float32) * (span[None, :] / 255.0)
+
+
+def fp16_encode(x):
+    return jnp.asarray(x).astype(jnp.float16)
+
+
+def fp16_decode(x):
+    return jnp.asarray(x).astype(jnp.float32)

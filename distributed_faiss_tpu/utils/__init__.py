@@ -1,0 +1,4 @@
+from distributed_faiss_tpu.utils.config import IndexCfg
+from distributed_faiss_tpu.utils.state import IndexState
+
+__all__ = ["IndexCfg", "IndexState"]

@@ -1,0 +1,38 @@
+"""Index lifecycle state machine.
+
+Behavioral parity with the reference's ``IndexState``
+(reference: distributed_faiss/index_state.py:11-36): four states and a
+cluster-level aggregation lattice used by clients polling a sharded index:
+TRAINING dominates, then NOT_TRAINED, then ADD, else TRAINED.
+"""
+
+from enum import Enum
+from typing import List
+
+
+class IndexState(Enum):
+    NOT_TRAINED = 1
+    TRAINING = 2
+    ADD = 3
+    TRAINED = 4
+
+    @staticmethod
+    def get_aggregated_states(states: List["IndexState"]) -> "IndexState":
+        """Collapse per-server states into one cluster state.
+
+        Lattice (reference: distributed_faiss/index_state.py:17-36):
+        any TRAINING -> TRAINING; else any NOT_TRAINED -> NOT_TRAINED;
+        else any ADD -> ADD; else TRAINED.
+        """
+        unique = set(states)
+        if not unique:
+            raise ValueError("cannot aggregate an empty state list")
+        if len(unique) == 1:
+            return unique.pop()
+        if IndexState.TRAINING in unique:
+            return IndexState.TRAINING
+        if IndexState.NOT_TRAINED in unique:
+            return IndexState.NOT_TRAINED
+        if IndexState.ADD in unique:
+            return IndexState.ADD
+        return IndexState.TRAINED

@@ -1,0 +1,28 @@
+"""Test harness configuration.
+
+Tests run on a *virtual 8-device CPU mesh* so the multi-chip sharding paths
+(parallel/mesh.py) execute without TPU hardware, mirroring how the reference
+fakes a cluster with in-process threads + loopback sockets
+(reference: tests/test_integration.py:51-115).
+
+The env vars must be set before jax initializes its backends, hence the
+module-level assignment in conftest (imported by pytest before any test
+module).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,59 @@
+"""IndexCfg unit tests (model: reference tests/test_integration.py:419-421)."""
+
+import json
+
+import pytest
+
+from distributed_faiss_tpu import IndexCfg
+
+
+def test_defaults():
+    cfg = IndexCfg()
+    assert cfg.dim == 768
+    assert cfg.metric == "dot"
+    assert cfg.nprobe == 1
+    assert cfg.buffer_bsz == 50000
+    assert cfg.save_interval_sec == -1
+    assert cfg.extra == {}
+
+
+def test_extra_kwargs_absorbed():
+    # The reference's own fixtures use keys that land in .extra
+    # (reference: tests/test_index_config.json, scripts/idx_cfg.json).
+    cfg = IndexCfg(dim=128, factory_type="IVFFlat", train_data_ratio=0.5)
+    assert cfg.dim == 128
+    assert cfg.extra["factory_type"] == "IVFFlat"
+    assert cfg.extra["train_data_ratio"] == 0.5
+
+
+def test_json_round_trip(tmp_path):
+    cfg = IndexCfg(
+        index_builder_type="knnlm",
+        dim=256,
+        metric="l2",
+        train_num=1000,
+        code_size=32,
+    )
+    p = tmp_path / "cfg.json"
+    cfg.save(str(p))
+    loaded = IndexCfg.from_json(str(p))
+    assert loaded.index_builder_type == "knnlm"
+    assert loaded.dim == 256
+    assert loaded.metric == "l2"
+    assert loaded.train_num == 1000
+    assert loaded.extra["code_size"] == 32
+
+
+def test_from_reference_style_json(tmp_path):
+    # A raw (non-round-trip) config file, like scripts/idx_cfg.json in the reference.
+    p = tmp_path / "raw.json"
+    p.write_text(json.dumps({"dim": 128, "faiss_factory": "IVF{centroids},SQ8", "centroids": 64}))
+    cfg = IndexCfg.from_json(str(p))
+    assert cfg.faiss_factory == "IVF{centroids},SQ8"
+    assert cfg.centroids == 64
+
+
+def test_bad_metric():
+    cfg = IndexCfg(metric="cosine")
+    with pytest.raises(RuntimeError):
+        cfg.get_metric()

@@ -1,0 +1,144 @@
+"""Golden tests: every kernel against numpy brute force (SURVEY §7 step 1)."""
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.ops import distance, kmeans, pq, sq
+
+
+def np_scores(q, x, metric):
+    if metric == "dot":
+        return q @ x.T
+    d = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    return -d
+
+
+@pytest.mark.parametrize("metric", ["dot", "l2"])
+def test_pairwise_scores_golden(rng, metric):
+    q = rng.standard_normal((7, 32)).astype(np.float32)
+    x = rng.standard_normal((50, 32)).astype(np.float32)
+    got = np.asarray(distance.pairwise_scores(q, x, metric))
+    want = np_scores(q, x, metric)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("metric", ["dot", "l2"])
+@pytest.mark.parametrize("chunk", [16, 64, 1024])
+def test_knn_golden(rng, metric, chunk):
+    q = rng.standard_normal((5, 24)).astype(np.float32)
+    x = rng.standard_normal((200, 24)).astype(np.float32)
+    k = 10
+    vals, ids = distance.knn(q, x, k, metric=metric, chunk=chunk)
+    vals, ids = np.asarray(vals), np.asarray(ids)
+    want = np_scores(q, x, metric)
+    want_ids = np.argsort(-want, axis=1)[:, :k]
+    np.testing.assert_array_equal(ids, want_ids)
+    np.testing.assert_allclose(vals, np.take_along_axis(want, want_ids, 1), rtol=1e-4, atol=1e-4)
+
+
+def test_knn_ntotal_masks_padding(rng):
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    x[40:] = 0.0  # capacity padding
+    q = rng.standard_normal((3, 8)).astype(np.float32)
+    vals, ids = distance.knn(q, x, 5, metric="l2", ntotal=40, chunk=16)
+    assert np.asarray(ids).max() < 40
+
+
+def test_merge_topk(rng):
+    a = rng.standard_normal((4, 6)).astype(np.float32)
+    b = rng.standard_normal((4, 9)).astype(np.float32)
+    ia = rng.integers(0, 100, (4, 6)).astype(np.int32)
+    ib = rng.integers(100, 200, (4, 9)).astype(np.int32)
+    v, i = distance.merge_topk(a, ia, b, ib, 5)
+    allv = np.concatenate([a, b], axis=1)
+    alli = np.concatenate([ia, ib], axis=1)
+    order = np.argsort(-allv, axis=1)[:, :5]
+    np.testing.assert_allclose(np.asarray(v), np.take_along_axis(allv, order, 1))
+    np.testing.assert_array_equal(np.asarray(i), np.take_along_axis(alli, order, 1))
+
+
+def test_kmeans_decreases_inertia(rng):
+    # Three well-separated blobs: k-means must recover them.
+    centers = np.array([[0, 0], [10, 10], [-10, 10]], dtype=np.float32)
+    x = np.concatenate(
+        [c + rng.standard_normal((100, 2)).astype(np.float32) * 0.5 for c in centers]
+    )
+    cent = np.asarray(kmeans.kmeans(x, 3, iters=15, chunk=64))
+    assert cent.shape == (3, 2)
+    # each true center has a learned centroid within 0.5
+    d = np.linalg.norm(centers[:, None, :] - cent[None, :, :], axis=-1)
+    assert d.min(axis=1).max() < 0.5
+
+
+def test_kmeans_batched_shapes(rng):
+    xs = rng.standard_normal((4, 300, 8)).astype(np.float32)
+    cent = np.asarray(kmeans.kmeans_batched(xs, 16, iters=5, chunk=128))
+    assert cent.shape == (4, 16, 8)
+    # subspaces are independent: different data -> different codebooks
+    assert not np.allclose(cent[0], cent[1])
+
+
+def test_sq8_round_trip(rng):
+    x = rng.standard_normal((100, 16)).astype(np.float32) * 3
+    params = sq.sq8_train(x)
+    codes = sq.sq8_encode(x, params["vmin"], params["span"])
+    assert np.asarray(codes).dtype == np.uint8
+    rec = np.asarray(sq.sq8_decode(codes, params["vmin"], params["span"]))
+    span = np.asarray(params["span"])
+    # quantization error bounded by half a grid step per dim
+    assert np.max(np.abs(rec - x) / span[None, :]) <= (1.0 / 255.0) * 0.51
+
+
+def test_pq_round_trip_quality(rng):
+    # PQ reconstruction should be far better than random guessing.
+    d, m = 32, 8
+    x = rng.standard_normal((2000, d)).astype(np.float32)
+    cb = pq.pq_train(x, m, iters=10)
+    assert np.asarray(cb).shape == (m, 256, d // m)
+    codes = pq.pq_encode(x, cb)
+    assert np.asarray(codes).shape == (2000, m)
+    rec = np.asarray(pq.pq_decode(codes, cb))
+    err = np.mean((rec - x) ** 2)
+    base = np.mean(x**2)
+    assert err < 0.5 * base
+
+
+@pytest.mark.parametrize("metric", ["dot", "l2"])
+def test_adc_matches_decoded_distance(rng, metric):
+    """ADC(lut, codes) must equal exact score against the decoded vectors."""
+    d, m = 16, 4
+    x = rng.standard_normal((500, d)).astype(np.float32)
+    q = rng.standard_normal((6, d)).astype(np.float32)
+    cb = pq.pq_train(x, m, iters=8)
+    codes = pq.pq_encode(x, cb)
+    rec = np.asarray(pq.pq_decode(codes, cb))
+    lut = pq.adc_lut(q, cb, metric=metric)
+    got = np.asarray(pq.adc_scan_shared(lut, codes))
+    want = np_scores(q, rec, metric)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_adc_scan_per_query_lists(rng):
+    d, m = 16, 4
+    x = rng.standard_normal((300, d)).astype(np.float32)
+    q = rng.standard_normal((3, d)).astype(np.float32)
+    cb = pq.pq_train(x, m, iters=5)
+    codes = np.asarray(pq.pq_encode(x, cb))
+    lists = np.stack([codes[0:10], codes[10:20], codes[20:30]])  # (3, 10, m)
+    lut = pq.adc_lut(q, cb, metric="l2")
+    got = np.asarray(pq.adc_scan(lut, lists))
+    rec = np.asarray(pq.pq_decode(codes, cb))
+    for qi in range(3):
+        want = np_scores(q[qi : qi + 1], rec[qi * 10 : (qi + 1) * 10], "l2")[0]
+        np.testing.assert_allclose(got[qi], want, rtol=1e-3, atol=1e-3)
+
+
+def test_bucket_and_pad():
+    assert distance.bucket_size(1) == 8
+    assert distance.bucket_size(8) == 8
+    assert distance.bucket_size(9) == 16
+    x = np.ones((3, 4), np.float32)
+    p = distance.pad_rows(x, 8)
+    assert p.shape == (8, 4)
+    np.testing.assert_array_equal(p[:3], x)
+    assert p[3:].sum() == 0

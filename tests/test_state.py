@@ -1,0 +1,34 @@
+"""State lattice unit tests (model: reference tests/test_index_state.py:13-22)."""
+
+import pytest
+
+from distributed_faiss_tpu import IndexState
+
+
+def agg(*states):
+    return IndexState.get_aggregated_states(list(states))
+
+
+def test_uniform():
+    for s in IndexState:
+        assert agg(s, s, s) == s
+
+
+def test_training_dominates():
+    assert agg(IndexState.TRAINED, IndexState.TRAINING) == IndexState.TRAINING
+    assert agg(IndexState.NOT_TRAINED, IndexState.TRAINING, IndexState.ADD) == IndexState.TRAINING
+
+
+def test_not_trained_next():
+    assert agg(IndexState.TRAINED, IndexState.NOT_TRAINED) == IndexState.NOT_TRAINED
+    assert agg(IndexState.ADD, IndexState.NOT_TRAINED) == IndexState.NOT_TRAINED
+
+
+def test_add_then_trained():
+    assert agg(IndexState.TRAINED, IndexState.ADD) == IndexState.ADD
+    assert agg(IndexState.TRAINED, IndexState.TRAINED) == IndexState.TRAINED
+
+
+def test_empty_raises():
+    with pytest.raises(ValueError):
+        IndexState.get_aggregated_states([])
