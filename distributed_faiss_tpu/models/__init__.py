@@ -1,0 +1,15 @@
+from distributed_faiss_tpu.models.base import TpuIndex, DeviceVectorStore, PaddedLists
+from distributed_faiss_tpu.models.flat import FlatIndex
+from distributed_faiss_tpu.models.ivf import IVFFlatIndex, IVFPQIndex
+from distributed_faiss_tpu.models.factory import build_index, INDEX_BUILDERS
+
+__all__ = [
+    "TpuIndex",
+    "DeviceVectorStore",
+    "PaddedLists",
+    "FlatIndex",
+    "IVFFlatIndex",
+    "IVFPQIndex",
+    "build_index",
+    "INDEX_BUILDERS",
+]

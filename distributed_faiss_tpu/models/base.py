@@ -1,0 +1,251 @@
+"""Index model interface + device-resident storage primitives.
+
+The model zoo replaces the FAISS index types the reference consumes
+(distributed_faiss/index.py:25-100). Two storage primitives solve the central
+TPU design problem — XLA wants static shapes, an ANN index wants to grow:
+
+- ``DeviceVectorStore``: a flat corpus as one (capacity, ...) HBM array.
+  Capacity grows by power-of-two reallocation; writes are bucketed
+  ``dynamic_update_slice`` calls so the number of compiled programs stays
+  O(log) in corpus size. Rows past ``ntotal`` are masked in every kernel.
+
+- ``PaddedLists``: ``nlist`` inverted lists as rectangular (nlist, cap, ...)
+  HBM arrays with a per-list fill count. Appends are host-planned (offset
+  bookkeeping in numpy) + one device scatter; capacity doubles when the
+  fullest list would overflow. Probed-list access is a plain gather, which
+  XLA handles with static shapes.
+
+Convention: models speak FAISS-style at their boundary — ``search`` returns
+(D, I) with D ascending for l2 / descending inner products for dot, ids are
+int64, missing results are id -1 (reference behavior via FAISS C++).
+"""
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_faiss_tpu.ops import distance
+
+
+def _next_pow2(n: int, minimum: int) -> int:
+    c = minimum
+    while c < n:
+        c *= 2
+    return c
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_rows(data, block, start):
+    return jax.lax.dynamic_update_slice(data, block, (start,) + (0,) * (data.ndim - 1))
+
+
+class DeviceVectorStore:
+    """Growable row store in device HBM (rows: vectors or code tuples)."""
+
+    MIN_CAP = 4096
+    WRITE_BUCKET = 1024  # row-count buckets for dynamic_update_slice programs
+
+    def __init__(self, row_shape: Tuple[int, ...], dtype, min_cap: int = None):
+        self.row_shape = tuple(row_shape)
+        self.dtype = dtype
+        self.min_cap = min_cap or self.MIN_CAP
+        self.cap = 0
+        self.ntotal = 0
+        self.data = None  # jnp (cap, *row_shape)
+
+    def _ensure(self, needed_rows: int):
+        # capacity covers ntotal + bucketed write length, so the clamped
+        # dynamic_update_slice can never shift a write onto live rows
+        bucket = _next_pow2(needed_rows, self.WRITE_BUCKET)
+        target = self.ntotal + bucket
+        if self.cap >= target:
+            return
+        newcap = _next_pow2(target, self.min_cap)
+        if self.data is None:
+            self.data = jnp.zeros((newcap,) + self.row_shape, self.dtype)
+        else:
+            pad = [(0, newcap - self.cap)] + [(0, 0)] * len(self.row_shape)
+            self.data = jnp.pad(self.data, pad)
+        self.cap = newcap
+
+    def add(self, rows: np.ndarray) -> Tuple[int, int]:
+        """Append rows; returns the (start, end) id range they occupy."""
+        n = rows.shape[0]
+        if n == 0:
+            return self.ntotal, self.ntotal
+        self._ensure(n)
+        bucket = _next_pow2(n, self.WRITE_BUCKET)
+        block = np.zeros((bucket,) + self.row_shape, dtype=self.dtype)
+        block[:n] = rows
+        self.data = _write_rows(self.data, jnp.asarray(block), self.ntotal)
+        start = self.ntotal
+        self.ntotal += n
+        return start, self.ntotal
+
+    def rows(self, ids: np.ndarray) -> np.ndarray:
+        """Fetch rows by id (host round-trip)."""
+        if self.data is None:
+            return np.zeros((0,) + self.row_shape, self.dtype)
+        return np.asarray(self.data[jnp.asarray(ids, jnp.int32)])
+
+    def all_rows(self) -> np.ndarray:
+        if self.data is None:
+            return np.zeros((0,) + self.row_shape, self.dtype)
+        return np.asarray(self.data[: self.ntotal])
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_lists(flat_data, flat_ids, pos, payload, gids):
+    flat_data = flat_data.at[pos].set(payload, mode="drop")
+    flat_ids = flat_ids.at[pos].set(gids, mode="drop")
+    return flat_data, flat_ids
+
+
+class PaddedLists:
+    """nlist growable inverted lists as rectangular padded device arrays."""
+
+    MIN_CAP = 64
+    APPEND_BUCKET = 1024
+
+    def __init__(self, nlist: int, payload_shape: Tuple[int, ...], dtype, min_cap: int = None):
+        self.nlist = nlist
+        self.payload_shape = tuple(payload_shape)
+        self.dtype = dtype
+        self.cap = min_cap or self.MIN_CAP
+        self.data = jnp.zeros((nlist, self.cap) + self.payload_shape, dtype)
+        self.ids = jnp.full((nlist, self.cap), -1, jnp.int32)
+        self.sizes_host = np.zeros(nlist, np.int64)
+        self._sizes_dev = jnp.zeros(nlist, jnp.int32)
+
+    @property
+    def sizes(self):
+        # device-cached (refreshed on append) so search calls don't pay a
+        # host->device transfer per query batch
+        return self._sizes_dev
+
+    @property
+    def ntotal(self) -> int:
+        return int(self.sizes_host.sum())
+
+    def _grow(self, needed_cap: int):
+        newcap = _next_pow2(needed_cap, self.cap)
+        if newcap == self.cap:
+            return
+        pad_d = [(0, 0), (0, newcap - self.cap)] + [(0, 0)] * len(self.payload_shape)
+        self.data = jnp.pad(self.data, pad_d)
+        self.ids = jnp.pad(self.ids, [(0, 0), (0, newcap - self.cap)], constant_values=-1)
+        self.cap = newcap
+
+    def append(self, list_idx: np.ndarray, payload: np.ndarray, gids: np.ndarray):
+        """Append payload rows to their assigned lists.
+
+        list_idx: (n,) int; payload: (n, *payload_shape); gids: (n,) global ids.
+        Offset planning is host-side numpy; the device side is one scatter.
+        """
+        n = list_idx.shape[0]
+        if n == 0:
+            return
+        counts = np.bincount(list_idx, minlength=self.nlist)
+        new_sizes = self.sizes_host + counts
+        if new_sizes.max() > self.cap:
+            self._grow(int(new_sizes.max()))
+
+        order = np.argsort(list_idx, kind="stable")
+        sorted_li = list_idx[order]
+        group_start = np.zeros(self.nlist + 1, np.int64)
+        group_start[1:] = np.cumsum(counts)
+        offs = np.arange(n, dtype=np.int64) - group_start[sorted_li]
+        pos = sorted_li.astype(np.int64) * self.cap + self.sizes_host[sorted_li] + offs
+
+        bucket = _next_pow2(n, self.APPEND_BUCKET)
+        pos_b = np.full(bucket, np.iinfo(np.int32).max, np.int64)  # dropped
+        pay_b = np.zeros((bucket,) + self.payload_shape, self.dtype)
+        gid_b = np.zeros(bucket, np.int32)
+        pos_b[:n] = pos
+        pay_b[:n] = payload[order]
+        gid_b[:n] = gids[order]
+
+        flat_data = self.data.reshape((self.nlist * self.cap,) + self.payload_shape)
+        flat_ids = self.ids.reshape(self.nlist * self.cap)
+        flat_data, flat_ids = _scatter_lists(
+            flat_data, flat_ids, jnp.asarray(pos_b), jnp.asarray(pay_b), jnp.asarray(gid_b)
+        )
+        self.data = flat_data.reshape((self.nlist, self.cap) + self.payload_shape)
+        self.ids = flat_ids.reshape(self.nlist, self.cap)
+        self.sizes_host = new_sizes
+        self._sizes_dev = jnp.asarray(new_sizes.astype(np.int32))
+
+
+class TpuIndex:
+    """Abstract index model (the FAISS-index-equivalent surface).
+
+    Subclasses: FlatIndex, IVFFlatIndex, IVFPQIndex (+ registered builders).
+    """
+
+    def __init__(self, dim: int, metric: str):
+        if metric not in ("dot", "l2"):
+            raise RuntimeError("Only dot and l2 metrics are supported.")
+        self.dim = dim
+        self.metric = metric
+        self.nprobe = 1
+
+    # --- lifecycle -------------------------------------------------------
+    @property
+    def is_trained(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def ntotal(self) -> int:
+        raise NotImplementedError
+
+    def train(self, x: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def add(self, x: np.ndarray) -> None:
+        """Append vectors; ids are sequential (positional metadata join,
+        reference: distributed_faiss/index.py:260-268)."""
+        raise NotImplementedError
+
+    # --- query -----------------------------------------------------------
+    def search(self, q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
+        """Return (approximate) stored vectors for ids (FAISS
+        search_and_reconstruct parity, reference index.py:255-257)."""
+        raise NotImplementedError
+
+    # --- knobs ------------------------------------------------------------
+    def set_nprobe(self, nprobe: int) -> None:
+        self.nprobe = int(nprobe)
+
+    def get_centroids(self) -> Optional[np.ndarray]:
+        return None
+
+    # --- persistence ------------------------------------------------------
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    @classmethod
+    def from_state_dict(cls, state: Dict[str, np.ndarray]) -> "TpuIndex":
+        raise NotImplementedError
+
+
+def finalize_results(scores: np.ndarray, ids: np.ndarray, metric: str):
+    """ops-convention (bigger-better scores, int32 ids) -> FAISS-style (D, I)."""
+    ids = ids.astype(np.int64)
+    if metric == "l2":
+        return -scores, ids
+    return scores, ids
+
+
+def query_blocks(q: np.ndarray, block: int = 256):
+    """Split a query batch into bucketed blocks to bound jit variants."""
+    nq = q.shape[0]
+    for s in range(0, nq, block):
+        chunk = q[s : s + block]
+        bucket = distance.bucket_size(chunk.shape[0])
+        yield s, chunk.shape[0], distance.pad_rows(chunk, bucket)

@@ -1,0 +1,118 @@
+"""Brute-force exact index (FAISS IndexFlatIP/IndexFlatL2 parity).
+
+Reference consumes flat indexes as both a standalone index type (`flat`
+builder, distributed_faiss/index.py:94) and the coarse quantizer for IVF
+variants (get_quantizer, index.py:25-33).
+
+The reference's `flat` builder lambda always builds IndexFlatIP, silently
+ignoring cfg.metric (index.py:94 vs the unused metric-respecting
+init_flat_index at index.py:89-90). We consciously fix that: FlatIndex honors
+the configured metric (golden tests pin ordering for both).
+
+Storage codecs: fp32 / fp16 / bf16 (cast fused into the scan matmul) and
+sq8 (int8 affine, dequantize-on-the-fly) — the sq8 variant also serves as
+the exact-search fallback substrate for `hnswsq` until the graph index lands.
+"""
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_faiss_tpu.models import base
+from distributed_faiss_tpu.ops import distance, sq
+
+_CODEC_DTYPES = {
+    "f32": jnp.float32,
+    "f16": jnp.float16,
+    "bf16": jnp.bfloat16,
+    "sq8": jnp.uint8,
+}
+
+
+class FlatIndex(base.TpuIndex):
+    def __init__(self, dim: int, metric: str = "l2", codec: str = "f32"):
+        super().__init__(dim, metric)
+        if codec not in _CODEC_DTYPES:
+            raise ValueError(f"unknown flat codec {codec!r}")
+        self.codec = codec
+        self.store = base.DeviceVectorStore((dim,), _CODEC_DTYPES[codec])
+        self.sq_params = None  # sq8 only: {"vmin", "span"} device arrays
+        self._trained = codec != "sq8"
+
+    @property
+    def is_trained(self) -> bool:
+        return self._trained
+
+    @property
+    def ntotal(self) -> int:
+        return self.store.ntotal
+
+    def train(self, x: np.ndarray) -> None:
+        if self.codec == "sq8":
+            self.sq_params = sq.sq8_train(np.asarray(x, np.float32))
+        self._trained = True
+
+    def add(self, x: np.ndarray) -> None:
+        if not self.is_trained:
+            raise RuntimeError("sq8 flat index must be trained before add")
+        x = np.asarray(x, np.float32)
+        if self.codec == "sq8":
+            rows = np.asarray(sq.sq8_encode(x, self.sq_params["vmin"], self.sq_params["span"]))
+        else:
+            rows = x
+        self.store.add(rows)
+
+    def search(self, q: np.ndarray, k: int):
+        nq = q.shape[0]
+        if self.ntotal == 0:
+            empty_d = np.full((nq, k), np.inf if self.metric == "l2" else -np.inf, np.float32)
+            return empty_d, np.full((nq, k), -1, np.int64)
+        q = np.asarray(q, np.float32)
+        out_s = np.empty((nq, k), np.float32)
+        out_i = np.empty((nq, k), np.int64)
+        kwargs = {}
+        if self.codec == "sq8":
+            kwargs = {"codec": "sq8", "vmin": self.sq_params["vmin"], "span": self.sq_params["span"]}
+        for s, n, block in base.query_blocks(q):
+            vals, ids = distance.knn(
+                block, self.store.data, k, metric=self.metric, ntotal=self.store.ntotal, **kwargs
+            )
+            out_s[s : s + n] = np.asarray(vals)[:n]
+            out_i[s : s + n] = np.asarray(ids)[:n]
+        return base.finalize_results(out_s, out_i, self.metric)
+
+    def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
+        rows = self.store.rows(np.asarray(ids))
+        if self.codec == "sq8":
+            return np.asarray(sq.sq8_decode(jnp.asarray(rows), self.sq_params["vmin"], self.sq_params["span"]))
+        return np.asarray(rows, np.float32)
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        state = {
+            "kind": "flat",
+            "dim": self.dim,
+            "metric": self.metric,
+            "codec": self.codec,
+            "trained": self._trained,
+            "ntotal": self.store.ntotal,
+            "data": self.store.all_rows(),
+        }
+        if self.sq_params is not None:
+            state["sq_vmin"] = np.asarray(self.sq_params["vmin"])
+            state["sq_span"] = np.asarray(self.sq_params["span"])
+        return state
+
+    @classmethod
+    def from_state_dict(cls, state) -> "FlatIndex":
+        idx = cls(int(state["dim"]), str(state["metric"]), str(state["codec"]))
+        if "sq_vmin" in state:
+            idx.sq_params = {
+                "vmin": jnp.asarray(state["sq_vmin"]),
+                "span": jnp.asarray(state["sq_span"]),
+            }
+        idx._trained = bool(state["trained"])
+        data = state["data"]
+        if data.shape[0]:
+            idx.store.add(data)
+        return idx

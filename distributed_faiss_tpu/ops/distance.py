@@ -77,12 +77,16 @@ def merge_topk(vals_a, ids_a, vals_b, ids_b, k: int):
     return best, jnp.take_along_axis(ids, pos, axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric", "chunk"))
-def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int):
+@functools.partial(jax.jit, static_argnames=("k", "metric", "chunk", "codec"))
+def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int, codec: str = "raw",
+              vmin=None, span=None):
     """Chunked corpus scan with running top-k.
 
     q: (nq, d) fp32; x: (cap, d) with cap % chunk == 0; ntotal: traced scalar —
     rows >= ntotal are masked to -inf so capacity padding never surfaces.
+    codec: 'raw' (any float dtype, cast to fp32) or 'sq8' (uint8 codes
+    dequantized on the fly with per-dim vmin/span — the decode fuses into the
+    matmul's operand load, so SQ8 storage costs bandwidth, not FLOPs).
     Returns (scores (nq, k), ids (nq, k) int32) sorted descending by score.
     """
     nq = q.shape[0]
@@ -102,6 +106,8 @@ def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int):
         ci, xc = inp
         best_v, best_i = carry
         xc = xc.astype(jnp.float32)
+        if codec == "sq8":
+            xc = vmin[None, :] + xc * (span[None, :] / 255.0)
         ip = _dot(q, xc.T)
         if metric == "dot":
             s = ip
@@ -121,7 +127,8 @@ def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int):
     return vals, ids
 
 
-def knn(q, x, k: int, metric: str = "l2", ntotal=None, chunk: int = 65536):
+def knn(q, x, k: int, metric: str = "l2", ntotal=None, chunk: int = 65536,
+        codec: str = "raw", vmin=None, span=None):
     """Exact k-nearest-neighbor scan of a (possibly capacity-padded) corpus.
 
     Returns bigger-is-better (scores, ids). ``ntotal`` masks padding rows;
@@ -137,4 +144,5 @@ def knn(q, x, k: int, metric: str = "l2", ntotal=None, chunk: int = 65536):
         # chunk-aligned so this path is cold.
         newcap = ((cap + chunk - 1) // chunk) * chunk
         x = jnp.pad(x, ((0, newcap - cap), (0, 0)))
-    return _knn_scan(q, x, jnp.asarray(ntotal, jnp.int32), k, metric, chunk)
+    return _knn_scan(q, x, jnp.asarray(ntotal, jnp.int32), k, metric, chunk,
+                     codec, vmin, span)

@@ -1,0 +1,5 @@
+from distributed_faiss_tpu.parallel import rpc
+from distributed_faiss_tpu.parallel.server import IndexServer
+from distributed_faiss_tpu.parallel.client import IndexClient
+
+__all__ = ["rpc", "IndexServer", "IndexClient"]

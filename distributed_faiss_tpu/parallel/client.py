@@ -1,0 +1,325 @@
+"""Cluster client: discovery, per-server stubs, fan-out, merge.
+
+Behavioral parity with the reference's ``IndexClient``
+(distributed_faiss/client.py:57-345): discovery-file wait with exponential
+backoff, one RPC stub + pool thread per server, round-robin add placement,
+fan-out search with client-side top-k merge (negated-dot semantics), filtered
+search with 3x over-fetch, cluster state aggregation, and broadcast ops
+(save/load/drop/ntotal/ids/centroids/nprobe).
+
+The merge replaces the reference's FAISS C++ ``float_maxheap_array_t``
+(ResultHeap, client.py:29-54) with a numpy concat + argpartition top-k —
+same semantics (min-merge over per-server blocks, dot scores negated before
+merging and returned negated, client.py:282-294), no native heap needed.
+"""
+
+import itertools
+import logging
+import os
+import random
+import time
+from multiprocessing.dummy import Pool as ThreadPool
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from distributed_faiss_tpu.parallel import rpc
+from distributed_faiss_tpu.utils.config import IndexCfg
+from distributed_faiss_tpu.utils.state import IndexState
+
+logger = logging.getLogger()
+
+
+def merge_result_blocks(
+    blocks: List[np.ndarray], topk: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """k-way min-merge of per-server (nq, k) score blocks.
+
+    Returns (D (nq, topk) ascending, I (nq, topk) int64 indices into the
+    horizontal concatenation of the blocks).
+    """
+    all_d = np.concatenate(blocks, axis=1)
+    if all_d.shape[1] > topk:
+        part = np.argpartition(all_d, topk - 1, axis=1)[:, :topk]
+        part_d = np.take_along_axis(all_d, part, axis=1)
+        order = np.argsort(part_d, kind="stable", axis=1)
+        ids = np.take_along_axis(part, order, axis=1)
+    else:
+        ids = np.argsort(all_d, kind="stable", axis=1)[:, :topk]
+    return np.take_along_axis(all_d, ids, axis=1), ids.astype(np.int64)
+
+
+class IndexClient:
+    """Handle to a cluster of index servers (one shard each)."""
+
+    def __init__(self, server_list_path: str, cfg_path: Optional[str] = None):
+        machine_ports = IndexClient.read_server_list(server_list_path)
+        self.sub_indexes = IndexClient.setup_connection(machine_ports)
+        self.num_indexes = len(self.sub_indexes)
+
+        # logical rank -> stub position, kept for rebalancing hooks
+        # (reference client.py:69-76)
+        index_ranks = [idx.get_rank() for idx in self.sub_indexes]
+        self.index_rank_to_id = {r: i for i, r in enumerate(index_ranks)}
+
+        self.pool = ThreadPool(self.num_indexes)
+        self.cur_server_ids = {}
+        random.seed(time.time())
+        self.cfg = IndexCfg.from_json(cfg_path) if cfg_path is not None else None
+
+    # ------------------------------------------------------------ discovery
+
+    @staticmethod
+    def read_server_list(
+        server_list_path: str,
+        initial_timeout: float = 0.1,
+        backoff_factor: float = 1.5,
+        total_max_timeout: float = 7200,
+    ) -> List[Tuple[str, int]]:
+        """Parse ``count\\nhost,port\\n...`` discovery files, waiting with
+        exponential backoff until the advertised server count has registered
+        (reference client.py:87-120)."""
+        time_waited = 0.0
+        while True:
+            num_servers = None
+            res = []
+            with open(server_list_path) as f:
+                for idx, line in enumerate(f):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if idx == 0:
+                        num_servers = int(line)
+                    else:
+                        host, port = line.split(",")[:2]
+                        res.append((host.strip(), int(port)))
+            if num_servers is None:
+                raise RuntimeError(f"empty server list {server_list_path}")
+            if num_servers == len(res):
+                return res
+            msg = (
+                f"{num_servers} != {len(res)} in server list {server_list_path}."
+            )
+            if time_waited + initial_timeout >= total_max_timeout:
+                raise RuntimeError(
+                    msg + f" Timed out after waiting {round(time_waited, 2)} seconds"
+                )
+            logger.info("%s waiting %.2fs for servers to register...", msg, initial_timeout)
+            time.sleep(initial_timeout)
+            time_waited += initial_timeout
+            initial_timeout *= backoff_factor
+
+    @staticmethod
+    def setup_connection(machine_ports) -> List[rpc.Client]:
+        return [
+            rpc.Client(i, host, port) for i, (host, port) in enumerate(machine_ports)
+        ]
+
+    # ------------------------------------------------------------ lifecycle
+
+    def create_index(self, index_id: str, cfg: Optional[IndexCfg] = None):
+        if cfg is not None:
+            self.cfg = cfg
+        if self.cfg is None:
+            self.cfg = IndexCfg()
+        return self.pool.map(
+            lambda idx: idx.create_index(index_id, self.cfg), self.sub_indexes
+        )
+
+    def drop_index(self, index_id: str):
+        self.pool.map(lambda idx: idx.drop_index(index_id), self.sub_indexes)
+
+    def save_index(self, index_id: str):
+        self.pool.map(lambda idx: idx.save_index(index_id), self.sub_indexes)
+
+    def load_index(
+        self,
+        index_id: str,
+        cfg: Optional[IndexCfg] = None,
+        force_reload: bool = True,
+    ) -> bool:
+        if force_reload:
+            self.pool.map(lambda idx: idx.drop_index(index_id), self.sub_indexes)
+        all_loaded = self.pool.map(
+            lambda idx: idx.load_index(index_id, cfg), self.sub_indexes
+        )
+        if cfg is None:
+            config_paths = self.pool.map(
+                lambda idx: idx.get_config_path(index_id), self.sub_indexes
+            )
+            if config_paths and os.path.isfile(config_paths[0]):
+                cfg = IndexCfg.from_json(config_paths[0])
+            else:
+                cfg = IndexCfg()
+        self.cfg = cfg
+
+        if all(all_loaded):
+            return True
+        if any(all_loaded):
+            logger.warning("some server nodes can't load index: %s", all_loaded)
+        return False
+
+    # ------------------------------------------------------------ ingest
+
+    def add_index_data(
+        self,
+        index_id: str,
+        embeddings: np.ndarray,
+        metadata: Optional[List[object]] = None,
+        train_async_if_triggered: bool = True,
+    ) -> None:
+        """Round-robin batch placement: first target random, then cyclic
+        (reference client.py:174-192) — each call lands on ONE server."""
+        if index_id not in self.cur_server_ids:
+            self.cur_server_ids[index_id] = random.randint(0, self.num_indexes - 1)
+        sid = self.cur_server_ids[index_id]
+        self.sub_indexes[sid].add_index_data(
+            index_id, embeddings, metadata, train_async_if_triggered
+        )
+        self.cur_server_ids[index_id] = (sid + 1) % self.num_indexes
+
+    def sync_train(self, index_id: str) -> None:
+        self.pool.map(lambda idx: idx.sync_train(index_id), self.sub_indexes)
+
+    def async_train(self, index_id: str) -> None:
+        # the reference's async_train also fans out sync_train
+        # (client.py:197-198); we dispatch the server-side async path
+        self.pool.map(lambda idx: idx.async_train(index_id), self.sub_indexes)
+
+    def add_buffer_to_index(self, index_id: str):
+        self.pool.map(lambda idx: idx.add_buffer_to_index(index_id), self.sub_indexes)
+
+    # ------------------------------------------------------------ query
+
+    def search(
+        self,
+        query: np.ndarray,
+        topk: int,
+        index_id: str,
+        return_embeddings: bool = False,
+    ) -> Tuple[np.ndarray, List]:
+        q_size = query.shape[0]
+        if self.cfg is None:
+            # without the metric we cannot merge correctly (dot needs
+            # negation); fail loudly instead of silently min-merging
+            raise RuntimeError(
+                "IndexClient has no cfg for this index: pass cfg_path at "
+                "construction, or call create_index/load_index first"
+            )
+        maximize_metric = self.cfg.metric == "dot"
+        results = self.pool.imap(
+            lambda idx: idx.search(index_id, query, topk, return_embeddings),
+            self.sub_indexes,
+        )
+        return IndexClient._aggregate_results(
+            results, topk, q_size, maximize_metric, return_embeddings
+        )
+
+    @staticmethod
+    def _aggregate_results(
+        results,
+        topk: int,
+        q_size: int,
+        maximize_metric: bool,
+        return_embeddings: bool,
+    ):
+        """Merge per-server (scores, meta, embs) tuples.
+
+        Matches the reference's heap semantics (client.py:265-310): for dot,
+        scores are negated before the min-merge and the *negated* values are
+        returned in D; metadata/embeddings join via synthetic concat ids.
+        """
+        meta = []
+        embs = []
+        blocks = []
+        for DI, MetaI, e in results:
+            blocks.append(-DI if maximize_metric else DI)
+            meta.extend(itertools.chain(*MetaI))
+            if return_embeddings:
+                embs.extend(itertools.chain(*e))
+        D, ids = merge_result_blocks(blocks, topk)
+        # map merged column index (server-block s, position j) to the flat
+        # meta list layout [server s][query i][pos j] — the same synthetic-id
+        # arithmetic the reference builds with arange blocks (client.py:287)
+        s, j = ids // topk, ids % topk
+        i = np.arange(q_size, dtype=np.int64)[:, None]
+        flat = (s * q_size * topk + i * topk + j).reshape(-1).tolist()
+        selected_meta = [meta[i] for i in flat]
+        to_matrix = lambda l: [l[i : i + topk] for i in range(0, len(l), topk)]
+        if return_embeddings:
+            selected_embs = [embs[i] for i in flat]
+            return D, to_matrix(selected_meta), to_matrix(selected_embs)
+        return D, to_matrix(selected_meta)
+
+    def search_with_filter(
+        self,
+        query: np.ndarray,
+        top_k: int,
+        index_id: str,
+        filter_pos: int = -1,
+        filter_value=None,
+    ):
+        """Metadata-filtered search with over-fetch (reference
+        client.py:213-263: fetch filter_top_factor*k, drop matches on
+        meta[filter_pos] == filter_value, keep first k survivors)."""
+        filter_top_factor = 3
+        actual_top_k = filter_top_factor * top_k if filter_pos >= 0 else top_k
+        scores, meta = self.search(query, actual_top_k, index_id)
+        if filter_pos < 0:
+            return scores, meta
+
+        new_scores, new_meta, short_ids = [], [], []
+        for i, meta_list in enumerate(meta):
+            kept_meta, kept_scores = [], []
+            for j, m in enumerate(meta_list):
+                if not m:
+                    continue
+                if len(m) > filter_pos and m[filter_pos] != filter_value:
+                    kept_meta.append(m)
+                    kept_scores.append(scores[i, j])
+                if len(kept_meta) >= top_k:
+                    break
+            if len(kept_meta) < top_k:
+                short_ids.append(i)
+            new_meta.append(kept_meta)
+            new_scores.append(np.asarray(kept_scores).reshape(-1, 1))
+        if short_ids:
+            logger.info(
+                "%d samples returned fewer than %d results after filtering",
+                len(short_ids), top_k,
+            )
+        return new_scores, new_meta
+
+    # ------------------------------------------------------------ observability
+
+    def get_state(self, index_id: str) -> IndexState:
+        states = self.pool.map(lambda idx: idx.get_state(index_id), self.sub_indexes)
+        return IndexState.get_aggregated_states(states)
+
+    def get_ntotal(self, index_id: str) -> int:
+        return sum(self.pool.map(lambda idx: idx.get_ntotal(index_id), self.sub_indexes))
+
+    def get_ids(self, index_id: str) -> set:
+        id_sets = self.pool.map(lambda idx: idx.get_ids(index_id), self.sub_indexes)
+        return set().union(*id_sets)
+
+    def get_centroids(self, index_id: str):
+        return self.pool.map(lambda idx: idx.get_centroids(index_id), self.sub_indexes)
+
+    def set_nprobe(self, index_id: str, nprobe: int):
+        return self.pool.map(
+            lambda idx: idx.set_nprobe(index_id, nprobe), self.sub_indexes
+        )
+
+    def set_omp_num_threads(self, num_threads: int) -> None:
+        self.pool.map(
+            lambda idx: idx.set_omp_num_threads(num_threads), self.sub_indexes
+        )
+
+    def get_num_servers(self) -> int:
+        return self.num_indexes
+
+    def close(self):
+        for conn in self.sub_indexes:
+            conn.close()
+        self.pool.terminate()

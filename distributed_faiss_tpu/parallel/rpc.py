@@ -1,0 +1,217 @@
+"""Binary tensor RPC: the client<->server control/data plane over DCN.
+
+Replaces the reference's pickle-over-TCP transport
+(distributed_faiss/rpc.py: FileSock 64 MiB chunked pickle streams, dynamic
+method dispatch via __getattr__, server exceptions re-raised client-side).
+
+Design differences (conscious, SURVEY §2.4):
+- Length-prefixed binary frames instead of a raw pickle stream: numpy/jax
+  tensors travel as raw buffers (dtype/shape header + bytes, no pickle
+  copy of the payload); only the object *skeleton* (method name, scalars,
+  metadata lists) is pickled. Embedding batches therefore move at
+  socket-memcpy speed and deserialize zero-copy into numpy.
+- Same external contract: ``Client.<anything>(...)`` performs a remote
+  call of that method name; server-side exceptions come back as
+  ``ServerException`` with the remote traceback (reference rpc.py:126-131);
+  clean shutdown via a CLOSE frame (reference ClientExit, rpc.py:96).
+
+Frame layout (little-endian):
+  magic b"DFT1" | kind u8 | skel_len u32 | narr u32 | skel bytes |
+  narr x [ dtype_len u8 | dtype utf8 | ndim u8 | dims u64* | data bytes ]
+"""
+
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+DEFAULT_PORT = 12032  # same default port as the reference (rpc.py:22)
+
+MAGIC = b"DFT1"
+KIND_CALL = 0
+KIND_RESULT = 1
+KIND_ERROR = 2
+KIND_CLOSE = 3
+
+_HDR = struct.Struct("<4sBII")
+
+
+class ClientExit(Exception):
+    """Raised server-side when a client sends a CLOSE frame."""
+
+
+class ServerException(Exception):
+    """A remote exception, carrying the server-side traceback text."""
+
+
+class _TensorRef:
+    __slots__ = ("idx",)
+
+    def __init__(self, idx: int):
+        self.idx = idx
+
+    def __reduce__(self):
+        return (_TensorRef, (self.idx,))
+
+
+def _extract(obj, arrays):
+    """Replace ndarrays in (nested) containers with _TensorRef placeholders."""
+    if isinstance(obj, np.ndarray):
+        a = np.ascontiguousarray(obj)
+        if a.dtype.hasobject:
+            return obj  # object arrays can't travel as raw buffers
+        arrays.append(a)
+        return _TensorRef(len(arrays) - 1)
+    if type(obj) is list:
+        return [_extract(v, arrays) for v in obj]
+    if type(obj) is tuple:
+        return tuple(_extract(v, arrays) for v in obj)
+    if type(obj) is dict:
+        return {k: _extract(v, arrays) for k, v in obj.items()}
+    # jax arrays and anything array-like with __array__ but not ndarray
+    if hasattr(obj, "__array__") and not isinstance(obj, (str, bytes)):
+        try:
+            return _extract(np.asarray(obj), arrays)
+        except Exception:
+            return obj
+    return obj
+
+
+def _restore(obj, arrays):
+    if isinstance(obj, _TensorRef):
+        return arrays[obj.idx]
+    if type(obj) is list:
+        return [_restore(v, arrays) for v in obj]
+    if type(obj) is tuple:
+        return tuple(_restore(v, arrays) for v in obj)
+    if type(obj) is dict:
+        return {k: _restore(v, arrays) for k, v in obj.items()}
+    return obj
+
+
+def _send_parts(sock: socket.socket, parts) -> None:
+    for p in parts:
+        sock.sendall(p)
+
+
+def pack_frame(kind: int, obj=None):
+    arrays = []
+    skel = pickle.dumps(_extract(obj, arrays), protocol=4)
+    parts = [_HDR.pack(MAGIC, kind, len(skel), len(arrays)), skel]
+    for a in arrays:
+        dt = a.dtype.str.encode()
+        hdr = struct.pack("<B", len(dt)) + dt + struct.pack("<B", a.ndim) + struct.pack(
+            f"<{a.ndim}Q", *a.shape
+        )
+        parts.append(hdr)
+        if a.size:  # zero-size arrays can't be cast to a byte view
+            parts.append(memoryview(a).cast("B"))
+    return parts
+
+
+def send_frame(sock: socket.socket, kind: int, obj=None) -> None:
+    _send_parts(sock, pack_frame(kind, obj))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> memoryview:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise EOFError("connection closed mid-frame" if got else "connection closed")
+        got += r
+    return view
+
+
+def recv_frame(sock: socket.socket):
+    head = _recv_exact(sock, _HDR.size)
+    magic, kind, skel_len, narr = _HDR.unpack(head)
+    if magic != MAGIC:
+        raise RuntimeError(f"bad frame magic {bytes(magic)!r}")
+    skel = pickle.loads(_recv_exact(sock, skel_len))
+    arrays = []
+    for _ in range(narr):
+        (dt_len,) = struct.unpack("<B", _recv_exact(sock, 1))
+        dt = np.dtype(bytes(_recv_exact(sock, dt_len)).decode())
+        (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
+        dims = struct.unpack(f"<{ndim}Q", _recv_exact(sock, 8 * ndim))
+        nbytes = int(np.prod(dims, dtype=np.int64)) * dt.itemsize if ndim else dt.itemsize
+        a = np.empty(dims, dtype=dt)
+        if nbytes:
+            view = memoryview(a).cast("B")
+            got = 0
+            while got < nbytes:
+                r = sock.recv_into(view[got:], nbytes - got)
+                if r == 0:
+                    raise EOFError("connection closed mid-tensor")
+                got += r
+        arrays.append(a)
+    return kind, _restore(skel, arrays)
+
+
+class Client:
+    """Dynamic-dispatch RPC stub: any attribute is a remote method
+    (reference rpc.py:137-138). One persistent connection, thread-safe."""
+
+    def __init__(self, client_id: int, host: str, port: int, v6: bool = False,
+                 connect_timeout: float = 60.0):
+        self.id = client_id
+        self.host = host
+        self.port = port
+        fam = socket.AF_INET6 if v6 else socket.AF_INET
+        # a server may register in the discovery file moments before its
+        # accept loop is up (the reference has the same gap,
+        # server_launcher.py:64 vs server.py:95): retry with backoff
+        deadline = time.time() + connect_timeout
+        delay = 0.05
+        while True:
+            self.sock = socket.socket(fam, socket.SOCK_STREAM)
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                self.sock.connect((host, port))
+                break
+            except (ConnectionRefusedError, ConnectionAbortedError, OSError):
+                self.sock.close()
+                if time.time() + delay > deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 1.6, 2.0)
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def generic_fun(self, fname: str, args=(), kwargs=None):
+        with self._lock:
+            send_frame(self.sock, KIND_CALL, (fname, tuple(args), kwargs or {}))
+            kind, payload = recv_frame(self.sock)
+        if kind == KIND_RESULT:
+            return payload
+        if kind == KIND_ERROR:
+            raise ServerException(payload)
+        raise RuntimeError(f"unexpected frame kind {kind}")
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            return self.generic_fun(name, args, kwargs)
+
+        call.__name__ = name
+        return call
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            with self._lock:
+                send_frame(self.sock, KIND_CLOSE, None)
+        except OSError:
+            pass
+        finally:
+            self.sock.close()

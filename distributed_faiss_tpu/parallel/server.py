@@ -1,0 +1,268 @@
+"""Index server runtime: one process = one shard rank, many named indexes.
+
+Behavioral parity with the reference's ``IndexServer``
+(distributed_faiss/server.py:36-404): multi-index registry guarded by a
+lock, storage path convention ``{storage_dir}/{index_id}/{rank}/``, RPC
+surface (create/add/search/train/state/save/load/drop/ntotal/ids/centroids/
+nprobe/config-path/stop), and two serving modes — a thread-per-connection
+blocking accept loop and a selector-based single-thread loop (the
+reference's selector mode is broken and its test skipped,
+tests/test_rpc.py:66; ours works and is tested).
+
+Conscious fixes vs the reference:
+- ``async_train`` actually starts the thread (the reference constructs a
+  Thread subclass but calls ``t.run()`` synchronously, server.py:308-318);
+- ``set_omp_num_threads`` exists server-side (the reference's client calls
+  a method the server never defined, client.py:338-339) — here it sets the
+  host-side intra-op hint and is otherwise a no-op, since XLA owns device
+  parallelism.
+"""
+
+import _thread
+import logging
+import os
+import pathlib
+import selectors
+import socket
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from distributed_faiss_tpu.engine import Index
+from distributed_faiss_tpu.parallel import rpc
+from distributed_faiss_tpu.utils.config import IndexCfg
+from distributed_faiss_tpu.utils.state import IndexState
+
+logger = logging.getLogger()
+
+
+class IndexServer:
+    def __init__(self, rank: int, index_storage_dir: str):
+        self.indexes: Dict[str, Index] = {}
+        self.indexes_lock = threading.Lock()
+        self.rank = rank
+        self.index_storage_dir = index_storage_dir
+        self.socket: Optional[socket.socket] = None
+        self._stopping = threading.Event()
+
+    # ------------------------------------------------------------ RPC surface
+
+    def create_index(self, index_id: str, cfg: IndexCfg) -> bool:
+        index_storage_dir = self._get_storage_dir(index_id, cfg)
+        cfg.index_storage_dir = index_storage_dir
+        pathlib.Path(index_storage_dir).mkdir(parents=True, exist_ok=True)
+        with self.indexes_lock:
+            if index_id not in self.indexes:
+                self.indexes[index_id] = Index(cfg)
+                logger.info("created index %s (storage %s)", index_id, index_storage_dir)
+                return True
+            return False
+
+    def add_index_data(
+        self,
+        index_id: str,
+        embeddings: np.ndarray,
+        metadata=None,
+        train_async_if_triggered: bool = True,
+    ) -> None:
+        self._get_index(index_id).add_batch(embeddings, metadata, train_async_if_triggered)
+
+    def search(self, index_id: str, query_batch: np.ndarray, top_k: int,
+               return_embeddings: bool = False) -> Tuple:
+        return self._get_index(index_id).search(
+            query_batch, top_k=top_k, return_embeddings=return_embeddings
+        )
+
+    def sync_train(self, index_id: str) -> None:
+        self._get_index(index_id).train()
+
+    def async_train(self, index_id: str) -> None:
+        _thread.start_new_thread(self._get_index(index_id).train, ())
+
+    def get_state(self, index_id: str) -> IndexState:
+        return self._get_index(index_id).get_state()
+
+    def get_ntotal(self, index_id: str) -> int:
+        with self.indexes_lock:
+            if index_id not in self.indexes:
+                return 0
+            index = self.indexes[index_id]
+        return index.get_idx_data_num()[1]
+
+    def get_aggregated_ntotal(self, index_id: str) -> int:
+        """Buffer depth, i.e. not-yet-indexed vectors (reference
+        server.py:268-272 returns the buffer size under this name)."""
+        return self._get_index(index_id).get_idx_data_num()[0]
+
+    def save_index(self, index_id: str) -> None:
+        self._get_index(index_id).save()
+
+    def load_index(self, index_id: str = "default", cfg: IndexCfg = None) -> bool:
+        index_dir = self._get_storage_dir(index_id, cfg)
+        if cfg:
+            cfg.index_storage_dir = index_dir
+        with self.indexes_lock:
+            if index_id in self.indexes:
+                if cfg:
+                    self.indexes[index_id].upd_cfg(cfg)
+                return True
+        index = Index.from_storage_dir(index_dir, cfg, ignore_buffer=False)
+        if index is None:
+            return False
+        with self.indexes_lock:
+            self.indexes[index_id] = index
+        return True
+
+    def drop_index(self, index_id: str) -> None:
+        with self.indexes_lock:
+            self.indexes.pop(index_id, None)
+
+    def get_ids(self, index_id: str = "default") -> set:
+        return self._get_index(index_id).get_ids()
+
+    def get_centroids(self, index_id: str):
+        return self._get_index(index_id).get_centroids()
+
+    def set_nprobe(self, index_id: str, nprobe: int) -> None:
+        return self._get_index(index_id).set_nprobe(nprobe)
+
+    def add_buffer_to_index(self, index_id: str) -> None:
+        return self._get_index(index_id).add_buffer_to_index()
+
+    def get_rank(self) -> int:
+        return self.rank
+
+    def index_loaded(self, index_id: str) -> bool:
+        with self.indexes_lock:
+            return (
+                index_id in self.indexes
+                and self.indexes[index_id].get_state() == IndexState.TRAINED
+            )
+
+    def get_config_path(self, index_id: str) -> str:
+        return os.path.join(self.index_storage_dir, index_id, str(self.rank), "cfg.json")
+
+    def set_omp_num_threads(self, num_threads: int) -> None:
+        # XLA owns device parallelism; keep the knob for host-side libs
+        os.environ["OMP_NUM_THREADS"] = str(num_threads)
+
+    def stop(self) -> None:
+        logger.info("stopping server rank=%d", self.rank)
+        self._stopping.set()
+        if self.socket is not None:
+            try:
+                self.socket.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self.socket.close()
+            self.socket = None
+        with self.indexes_lock:
+            indexes = list(self.indexes.values())
+        for index in indexes:
+            index.save()
+
+    # ------------------------------------------------------------ serving loops
+
+    def _bind(self, port: int, v6: bool) -> socket.socket:
+        fam = socket.AF_INET6 if v6 else socket.AF_INET
+        s = socket.socket(fam, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", port))
+        s.listen(16)
+        self.socket = s
+        return s
+
+    def start_blocking(self, port: int = rpc.DEFAULT_PORT, v6: bool = False,
+                       load_index: bool = False) -> None:
+        """Thread-per-connection accept loop (reference server.py:95-135)."""
+        if load_index:
+            self.load_index()
+        s = self._bind(port, v6)
+        logger.info("server rank=%d listening on :%d", self.rank, port)
+        while not self._stopping.is_set():
+            try:
+                conn, addr = s.accept()
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _thread.start_new_thread(self._serve_connection, (conn, addr))
+
+    def _serve_connection(self, conn: socket.socket, addr) -> None:
+        try:
+            while True:
+                self._one_call(conn)
+        except (rpc.ClientExit, EOFError):
+            pass
+        except OSError as e:
+            logger.info("socket error from %s: %s", addr, e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _one_call(self, conn: socket.socket) -> None:
+        kind, payload = rpc.recv_frame(conn)
+        if kind == rpc.KIND_CLOSE:
+            raise rpc.ClientExit("client closed")
+        if kind != rpc.KIND_CALL:
+            raise RuntimeError(f"unexpected frame kind {kind}")
+        fname, args, kwargs = payload
+        try:
+            fn = getattr(self, fname)
+            if fname.startswith("_"):
+                raise AttributeError(fname)
+            ret = fn(*args, **kwargs)
+            rpc.send_frame(conn, rpc.KIND_RESULT, ret)
+        except Exception:
+            import traceback
+
+            tb = traceback.format_exc()
+            logger.error("exception in %s: %s", fname, tb)
+            rpc.send_frame(conn, rpc.KIND_ERROR, tb)
+
+    def start(self, port: int = rpc.DEFAULT_PORT, v6: bool = False) -> None:
+        """Selector-based single-thread loop. The reference ships a broken
+        version of this mode (its test is @skip'ed); ours blocks per ready
+        connection on a full frame, which is correct (if lower-throughput
+        than the threaded mode) for well-behaved clients."""
+        s = self._bind(port, v6)
+        s.setblocking(True)
+        sel = selectors.DefaultSelector()
+        sel.register(s, selectors.EVENT_READ, data=None)
+        logger.info("selector server rank=%d on :%d", self.rank, port)
+        while not self._stopping.is_set():
+            try:
+                events = sel.select(timeout=0.5)
+            except OSError:
+                break
+            for key, _ in events:
+                if key.data is None:
+                    try:
+                        conn, addr = s.accept()
+                    except OSError:
+                        continue
+                    sel.register(conn, selectors.EVENT_READ, data=addr)
+                else:
+                    conn = key.fileobj
+                    try:
+                        self._one_call(conn)
+                    except (rpc.ClientExit, EOFError, OSError):
+                        sel.unregister(conn)
+                        conn.close()
+        sel.close()
+
+    # ------------------------------------------------------------ internals
+
+    def _get_index(self, index_id: str) -> Index:
+        with self.indexes_lock:
+            if index_id not in self.indexes:
+                raise RuntimeError(f"Server has no index with id={index_id}")
+            return self.indexes[index_id]
+
+    def _get_storage_dir(self, index_id: str, cfg: Optional[IndexCfg]) -> str:
+        base = cfg.index_storage_dir if cfg and cfg.index_storage_dir else None
+        if not base:
+            return os.path.join(self.index_storage_dir, index_id, str(self.rank))
+        return os.path.join(base, str(self.rank))

@@ -1,0 +1,33 @@
+"""Shard persistence: index state_dicts as npz (arrays) + json header.
+
+Our own serialization format replacing ``faiss.write_index/read_index``
+(reference: distributed_faiss/index.py:460,297). Numeric arrays go in an
+npz (no pickle needed for tensor data); scalars/strings ride in a json
+header stored as a uint8 array inside the same file.
+"""
+
+import json
+
+import numpy as np
+
+_META_KEY = "__meta__"
+
+
+def save_state(path: str, state: dict) -> None:
+    arrays = {}
+    scalars = {}
+    for k, v in state.items():
+        if isinstance(v, np.ndarray):
+            arrays[k] = v
+        else:
+            scalars[k] = v
+    arrays[_META_KEY] = np.frombuffer(json.dumps(scalars).encode("utf-8"), dtype=np.uint8)
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+
+
+def load_state(path: str) -> dict:
+    with np.load(path, allow_pickle=False) as z:
+        state = {k: z[k] for k in z.files if k != _META_KEY}
+        state.update(json.loads(bytes(z[_META_KEY].tobytes()).decode("utf-8")))
+    return state

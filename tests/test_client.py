@@ -1,0 +1,67 @@
+"""Client unit tests: discovery parsing + result aggregation golden
+(model: reference tests/test_client.py:19-39 and
+tests/test_integration.py:181-203)."""
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.parallel.client import IndexClient, merge_result_blocks
+
+
+def write_list(tmp_path, count, entries, name="servers.txt"):
+    p = tmp_path / name
+    lines = [str(count)] + [f"{h},{p_}" for h, p_ in entries]
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_read_server_list_ok(tmp_path):
+    path = write_list(tmp_path, 3, [("a", 1), ("b", 2), ("c", 3)])
+    assert IndexClient.read_server_list(path) == [("a", 1), ("b", 2), ("c", 3)]
+
+
+def test_read_server_list_timeout(tmp_path):
+    path = write_list(tmp_path, 4, [("a", 1), ("b", 2), ("c", 3)])
+    with pytest.raises(RuntimeError) as ei:
+        IndexClient.read_server_list(path, total_max_timeout=0)
+    assert "4 != 3" in str(ei.value)
+
+
+def test_merge_result_blocks():
+    a = np.array([[1.0, 3.0], [0.5, 2.0]], np.float32)
+    b = np.array([[2.0, 0.1], [4.0, 5.0]], np.float32)
+    D, I = merge_result_blocks([a, b], 2)
+    np.testing.assert_allclose(D, [[0.1, 1.0], [0.5, 2.0]])
+    np.testing.assert_array_equal(I, [[3, 0], [0, 1]])
+
+
+def mock_server_results(metric_max):
+    # two servers, 2 queries, k=3; metadata = ("s{server}", j)
+    d0 = np.array([[0.9, 0.5, 0.1], [0.8, 0.6, 0.3]], np.float32)
+    d1 = np.array([[0.7, 0.4, 0.2], [1.0, 0.95, 0.05]], np.float32)
+    if not metric_max:  # l2-style: ascending best-first within each server
+        d0 = np.sort(d0, axis=1)
+        d1 = np.sort(d1, axis=1)
+    m0 = [[("s0", j) for j in range(3)] for _ in range(2)]
+    m1 = [[("s1", j) for j in range(3)] for _ in range(2)]
+    return [(d0, m0, None), (d1, m1, None)]
+
+
+def test_aggregate_results_minimize():
+    results = mock_server_results(metric_max=False)
+    D, meta = IndexClient._aggregate_results(results, 3, 2, False, False)
+    # ascending merge of the two sorted rows
+    assert D.shape == (2, 3)
+    assert np.all(np.diff(D, axis=1) >= 0)
+    # query 0: server0 row [0.1,0.5,0.9], server1 [0.2,0.4,0.7] -> 0.1,0.2,0.4
+    np.testing.assert_allclose(D[0], [0.1, 0.2, 0.4])
+    assert meta[0][0] == ("s0", 0) and meta[0][1] == ("s1", 0) and meta[0][2] == ("s1", 1)
+
+
+def test_aggregate_results_maximize():
+    results = mock_server_results(metric_max=True)
+    D, meta = IndexClient._aggregate_results(results, 3, 2, True, False)
+    # dot semantics: D holds NEGATED similarities, ascending
+    # (reference client.py:282-294)
+    np.testing.assert_allclose(D[1], [-1.0, -0.95, -0.8])
+    assert meta[1][0] == ("s1", 0) and meta[1][1] == ("s1", 1) and meta[1][2] == ("s0", 0)

@@ -1,0 +1,164 @@
+"""Engine tests: state machine, threshold training, persistence.
+
+Models the reference's integration assertions (tests/test_integration.py:
+train_num honored :117-146, config persistence :332-385, centroids :387-416)
+at the single-shard level.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.engine import Index, infer_n_centroids
+from distributed_faiss_tpu.utils.config import IndexCfg
+from distributed_faiss_tpu.utils.state import IndexState
+
+
+def wait_state(idx, state, timeout=30.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if idx.get_state() == state:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def flat_cfg(**kw):
+    kw.setdefault("index_builder_type", "flat")
+    kw.setdefault("dim", 16)
+    kw.setdefault("metric", "l2")
+    kw.setdefault("train_num", 100)
+    return IndexCfg(**kw)
+
+
+def test_train_num_honored(rng):
+    idx = Index(flat_cfg())
+    x = rng.standard_normal((99, 16)).astype(np.float32)
+    idx.add_batch(x, [("m", i) for i in range(99)], train_async_if_triggered=False)
+    assert idx.get_state() == IndexState.NOT_TRAINED
+    idx.add_batch(x[:1], [("m", 99)], train_async_if_triggered=False)
+    assert wait_state(idx, IndexState.TRAINED)
+    buf, indexed = idx.get_idx_data_num()
+    assert buf == 0 and indexed == 100
+
+
+def test_search_with_metadata(rng):
+    idx = Index(flat_cfg(train_num=50))
+    x = rng.standard_normal((200, 16)).astype(np.float32)
+    meta = [("doc", i) for i in range(200)]
+    idx.add_batch(x, meta, train_async_if_triggered=False)
+    assert wait_state(idx, IndexState.TRAINED)
+    q = x[:3] + 1e-6
+    scores, results_meta, embs = idx.search(q, 5)
+    assert scores.shape == (3, 5)
+    assert embs is None
+    # nearest neighbor of x[i] is x[i] itself -> metadata joins positionally
+    for i in range(3):
+        assert results_meta[i][0] == ("doc", i)
+
+
+def test_search_return_embeddings(rng):
+    idx = Index(flat_cfg(train_num=10))
+    x = rng.standard_normal((50, 16)).astype(np.float32)
+    idx.add_batch(x, None, train_async_if_triggered=False)
+    assert wait_state(idx, IndexState.TRAINED)
+    scores, meta, embs = idx.search(x[:2], 3, return_embeddings=True)
+    assert len(embs) == 2 and len(embs[0]) == 3
+    np.testing.assert_allclose(embs[0][0], x[0], rtol=1e-5)
+
+
+def test_search_untrained_raises(rng):
+    idx = Index(flat_cfg())
+    with pytest.raises(RuntimeError):
+        idx.search(rng.standard_normal((1, 16)).astype(np.float32), 3)
+
+
+def test_async_add_path(rng):
+    idx = Index(flat_cfg(train_num=50, buffer_bsz=64))
+    x = rng.standard_normal((50, 16)).astype(np.float32)
+    idx.add_batch(x, None, train_async_if_triggered=False)
+    assert wait_state(idx, IndexState.TRAINED)
+    # more data after training: flows through ADD back to TRAINED
+    for _ in range(4):
+        idx.add_batch(rng.standard_normal((100, 16)).astype(np.float32), None)
+    assert wait_state(idx, IndexState.TRAINED)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        buf, indexed = idx.get_idx_data_num()
+        if buf == 0 and indexed == 450:
+            break
+        time.sleep(0.05)
+    assert (buf, indexed) == (0, 450)
+
+
+def test_save_load_round_trip(rng, tmp_path):
+    storage = str(tmp_path / "shard")
+    idx = Index(flat_cfg(train_num=20, index_storage_dir=storage))
+    x = rng.standard_normal((120, 16)).astype(np.float32)
+    meta = [("m", i) for i in range(120)]
+    idx.add_batch(x, meta, train_async_if_triggered=False)
+    assert wait_state(idx, IndexState.TRAINED)
+    assert idx.save() is True
+
+    loaded = Index.from_storage_dir(storage)
+    assert loaded is not None
+    assert loaded.get_state() == IndexState.TRAINED
+    q = x[:2]
+    s0, m0, _ = idx.search(q, 4)
+    s1, m1, _ = loaded.search(q, 4)
+    np.testing.assert_allclose(s0, s1, rtol=1e-5)
+    assert m0 == m1
+    # cfg persisted alongside
+    assert loaded.cfg.train_num == 20
+
+
+def test_load_missing_returns_none(tmp_path):
+    assert Index.from_storage_dir(str(tmp_path / "nope")) is None
+
+
+def test_ivf_engine_centroids(rng, tmp_path):
+    cfg = IndexCfg(
+        index_builder_type="ivf_simple", dim=16, metric="dot",
+        train_num=300, centroids=8, nprobe=8,
+    )
+    idx = Index(cfg)
+    x = rng.standard_normal((400, 16)).astype(np.float32)
+    idx.add_batch(x, None, train_async_if_triggered=False)
+    assert wait_state(idx, IndexState.TRAINED)
+    cents = idx.get_centroids()
+    assert cents.shape == (8, 16)
+    idx.set_nprobe(4)
+    assert idx.tpu_index.nprobe == 4
+
+
+def test_infer_centroids_tiers():
+    assert infer_n_centroids(10000) == int(2 * 100)
+    assert infer_n_centroids(2_000_000) == 65536
+    assert infer_n_centroids(20_000_000) == 262144
+    assert infer_n_centroids(200_000_000) == 1048576
+
+
+def test_get_ids_custom_idx(rng):
+    idx = Index(flat_cfg(train_num=10, custom_meta_id_idx=1))
+    x = rng.standard_normal((20, 16)).astype(np.float32)
+    idx.add_batch(x, [("a", 100 + i) for i in range(20)], train_async_if_triggered=False)
+    assert idx.get_ids() == set(range(100, 120))
+
+
+def test_drop_index(rng):
+    idx = Index(flat_cfg(train_num=10))
+    idx.add_batch(rng.standard_normal((20, 16)).astype(np.float32), None,
+                  train_async_if_triggered=False)
+    assert wait_state(idx, IndexState.TRAINED)
+    idx.drop_index()
+    assert idx.get_state() == IndexState.NOT_TRAINED
+    assert idx.get_idx_data_num() == (0, 0)
+
+
+def test_dim_inferred_when_zero(rng):
+    idx = Index(IndexCfg(index_builder_type="flat", dim=0, metric="l2", train_num=10))
+    idx.add_batch(rng.standard_normal((20, 24)).astype(np.float32), None,
+                  train_async_if_triggered=False)
+    assert wait_state(idx, IndexState.TRAINED)
+    assert idx.cfg.dim == 24
