@@ -71,9 +71,14 @@ def _build_hnswsq(cfg: IndexCfg) -> FlatIndex:
     return FlatIndex(cfg.dim, "l2", codec="sq8")
 
 
-def _build_ivf_tpu(cfg: IndexCfg) -> IVFFlatIndex:
-    return IVFFlatIndex(cfg.dim, _centroids(cfg), cfg.get_metric(), "f32",
-                        kmeans_iters=_kmeans_iters(cfg))
+def _build_ivf_tpu(cfg: IndexCfg):
+    # lazy import: mesh pulls in jax.sharding machinery only when used
+    from distributed_faiss_tpu.parallel.mesh import IvfTpuIndex, make_mesh
+
+    n_dev = cfg.extra.get("mesh_devices")
+    mesh = make_mesh(int(n_dev)) if n_dev else None
+    return IvfTpuIndex(cfg.dim, _centroids(cfg), cfg.get_metric(), "f32",
+                       mesh=mesh, kmeans_iters=_kmeans_iters(cfg))
 
 
 INDEX_BUILDERS = {
@@ -158,21 +163,26 @@ def build_index(cfg: IndexCfg):
     )
 
 
-_STATE_KINDS = None
+def _sharded_flat_cls():
+    # lazy: only deserializing a sharded index pays the mesh import
+    from distributed_faiss_tpu.parallel.mesh import ShardedFlatIndex
+
+    return ShardedFlatIndex
+
+
+_STATE_KINDS = {
+    "flat": lambda: FlatIndex,
+    "ivf_flat": lambda: IVFFlatIndex,
+    "ivf_pq": lambda: IVFPQIndex,
+    "sharded_flat": _sharded_flat_cls,
+}
 
 
 def index_from_state_dict(state):
     """Rebuild any registered index model from its state_dict."""
-    global _STATE_KINDS
-    if _STATE_KINDS is None:
-        _STATE_KINDS = {
-            "flat": FlatIndex,
-            "ivf_flat": IVFFlatIndex,
-            "ivf_pq": IVFPQIndex,
-        }
     kind = str(state["kind"])
     try:
-        cls = _STATE_KINDS[kind]
+        cls = _STATE_KINDS[kind]()
     except KeyError:
         raise RuntimeError(f"unknown serialized index kind {kind!r}")
     return cls.from_state_dict(state)

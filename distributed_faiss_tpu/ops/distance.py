@@ -97,9 +97,15 @@ def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int, codec: str = "raw",
 
     x_chunks = x.reshape(nchunks, chunk, x.shape[1])
 
+    # the never-taken select keeps a structural data dependency on x so the
+    # carry's device-varying annotation stays consistent when this scan runs
+    # inside shard_map (each shard carries its own top-k; without it jax
+    # rejects the scan with a vma mismatch). A select — unlike `x[0,0]*0` —
+    # cannot propagate NaN/Inf from the corpus into the init.
+    anchor = jnp.where(jnp.zeros((), bool), x[0, 0].astype(jnp.float32), 0.0)
     init = (
-        jnp.full((nq, k), NEG_INF, dtype=jnp.float32),
-        jnp.full((nq, k), -1, dtype=jnp.int32),
+        jnp.full((nq, k), NEG_INF, dtype=jnp.float32) + anchor,
+        jnp.full((nq, k), -1, dtype=jnp.int32) + anchor.astype(jnp.int32),
     )
 
     def body(carry, inp):

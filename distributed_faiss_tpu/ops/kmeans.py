@@ -18,6 +18,42 @@ import jax
 import jax.numpy as jnp
 
 
+def accumulate_clusters(x_chunks, w_chunks, cent, k: int):
+    """Lloyd assignment + accumulation over pre-chunked points.
+
+    x_chunks: (nchunks, chunk, d); w_chunks: (nchunks, chunk) 0/1 weights.
+    Returns (sums (k, d), counts (k,)). Per chunk: argmin assignment over a
+    (chunk, k) distance block, then the centroid accumulation as the one-hot
+    matmul ``onehot.T @ points`` — both MXU work. Shared by the single-device
+    loop below and the mesh-sharded step (parallel/mesh.py), which psums the
+    results across shards.
+    """
+    d = x_chunks.shape[2]
+    cn = jnp.sum(cent * cent, axis=1)
+    # never-taken select: keeps the scan carry's shard_map vma annotation
+    # consistent with the sharded inputs without propagating NaN/Inf values
+    anchor = jnp.where(jnp.zeros((), bool), x_chunks[0, 0, 0].astype(jnp.float32), 0.0)
+
+    def chunk_body(carry, inp):
+        sums, counts = carry
+        pts, w = inp
+        ip = jnp.dot(pts, cent.T, precision=jax.lax.Precision.HIGHEST,
+                     preferred_element_type=jnp.float32)
+        assign = jnp.argmin(-2.0 * ip + cn[None, :], axis=1)
+        onehot = jax.nn.one_hot(assign, k, dtype=jnp.float32) * w[:, None]
+        sums = sums + jnp.dot(onehot.T, pts, precision=jax.lax.Precision.HIGHEST,
+                              preferred_element_type=jnp.float32)
+        counts = counts + jnp.sum(onehot, axis=0)
+        return (sums, counts), None
+
+    (sums, counts), _ = jax.lax.scan(
+        chunk_body,
+        (jnp.zeros((k, d), jnp.float32) + anchor, jnp.zeros((k,), jnp.float32) + anchor),
+        (x_chunks, w_chunks),
+    )
+    return sums, counts
+
+
 def _init_random(x, mask, key, k: int):
     """k distinct valid points via Gumbel top-k (uniform w/o replacement)."""
     g = jax.random.gumbel(key, (x.shape[0],))
@@ -71,24 +107,7 @@ def _kmeans_jit(x, mask, key, k: int, iters: int, chunk: int, pp_init: bool):
         init_centroids = _init_random(x, mask, key, k)
 
     def iteration(cent, _):
-        cn = jnp.sum(cent * cent, axis=1)
-
-        def chunk_body(carry, inp):
-            sums, counts = carry
-            pts, w = inp
-            ip = jnp.dot(pts, cent.T, precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32)
-            d2 = -2.0 * ip + cn[None, :]
-            assign = jnp.argmin(d2, axis=1)
-            onehot = jax.nn.one_hot(assign, k, dtype=jnp.float32) * w[:, None]
-            sums = sums + jnp.dot(onehot.T, pts, precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32)
-            counts = counts + jnp.sum(onehot, axis=0)
-            return (sums, counts), None
-
-        (sums, counts), _ = jax.lax.scan(
-            chunk_body,
-            (jnp.zeros((k, d), jnp.float32), jnp.zeros((k,), jnp.float32)),
-            (xc, mc),
-        )
+        sums, counts = accumulate_clusters(xc, mc, cent, k)
         new = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], cent)
         return new, None
 
