@@ -26,9 +26,11 @@ import time
 import numpy as np
 
 
-def make_corpus(rng, n, d, n_clusters):
-    centers = rng.standard_normal((n_clusters, d)).astype(np.float32) * 4.0
-    assign = rng.integers(0, n_clusters, n)
+def make_corpus(rng, n, d, centers):
+    """Draw n points from the given gaussian-mixture centers (corpus and
+    queries must share centers — OOD queries make the nprobe sweep
+    unrealistically pessimistic)."""
+    assign = rng.integers(0, centers.shape[0], n)
     x = centers[assign] + rng.standard_normal((n, d)).astype(np.float32)
     return x.astype(np.float32)
 
@@ -56,8 +58,9 @@ def main():
     nq_eval, nq_bench = 200, 512
     rng = np.random.default_rng(0)
 
-    x = make_corpus(rng, n, d, n_clusters)
-    q = make_corpus(rng, nq_eval + nq_bench, d, n_clusters)
+    centers = rng.standard_normal((n_clusters, d)).astype(np.float32) * 4.0
+    x = make_corpus(rng, n, d, centers)
+    q = make_corpus(rng, nq_eval + nq_bench, d, centers)
     q_eval, q_bench = q[:nq_eval], q[nq_eval:]
 
     import jax

@@ -1,0 +1,125 @@
+"""Pallas TPU kernel for the PQ asymmetric-distance (ADC) scan.
+
+The ADC contract (ops/pq.py): scores[q, c] = sum_m lut[q, m, codes[c, m]].
+SURVEY §7 calls this the kernel that decides IVF-PQ QPS. The XLA fallback
+expresses the LUT gather as a one-hot einsum; this kernel fuses the whole
+pipeline in VMEM so the one-hot never exists in HBM:
+
+  per (query-block, candidate-tile) grid step, for each subspace m
+  (statically unrolled): build the (TILE, ksub) one-hot on the VPU from a
+  broadcasted iota compare against the uint8 codes, and accumulate
+  lut_m @ onehot.T on the MXU into the (nq, TILE) output block.
+
+VMEM budget per step: lut (nq x m*ksub fp32) + codes tile (TILE x m u8) +
+one (TILE, ksub) one-hot + (nq, TILE) accumulator — a few MB at the default
+TILE=512, nq<=128, m<=64, well under the ~16 MB/core budget.
+
+``interpret=True`` (automatic off-TPU) runs the same kernel through the
+Pallas interpreter so CPU tests cover the exact kernel code path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_TILE = 512
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # pragma: no cover
+        return False
+
+
+def _adc_accumulate(m: int, ksub: int, lut, codes):
+    """lut: (nq, m*ksub) f32; codes: (TILE, m) u8 -> (nq, TILE) f32."""
+    tile = codes.shape[0]
+    nq = lut.shape[0]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (tile, ksub), 1)
+    acc = jnp.zeros((nq, tile), jnp.float32)
+    for mi in range(m):  # static unroll: m is a compile-time constant
+        cm = codes[:, mi].astype(jnp.int32).reshape(tile, 1)
+        onehot = (cm == iota).astype(jnp.float32)  # (TILE, ksub) on the VPU
+        lut_m = lut[:, mi * ksub:(mi + 1) * ksub]  # (nq, ksub)
+        # HIGHEST: match the XLA ADC path (pq.py) — default bf16 MXU passes
+        # perturb lut values enough to reorder near-tie candidates
+        acc = acc + jnp.dot(lut_m, onehot.T, precision=jax.lax.Precision.HIGHEST,
+                            preferred_element_type=jnp.float32)
+    return acc
+
+
+def _adc_kernel(m: int, ksub: int, lut_ref, codes_ref, out_ref):
+    out_ref[:, :] = _adc_accumulate(m, ksub, lut_ref[:, :], codes_ref[:, :])
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def adc_scan_shared_pallas(lut, codes, tile: int = DEFAULT_TILE, interpret: bool = False):
+    """ADC scan of one shared candidate list.
+
+    lut: (nq, m, ksub) f32; codes: (L, m) uint8 -> (nq, L) f32 scores.
+    Grid over candidate tiles; L is padded to a tile multiple (scores for
+    padding rows are garbage and sliced off).
+    """
+    nq, m, ksub = lut.shape
+    L = codes.shape[0]
+    tile = min(tile, max(8, L))
+    Lp = -(-L // tile) * tile
+    if Lp != L:
+        codes = jnp.pad(codes, ((0, Lp - L), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_adc_kernel, m, ksub),
+        grid=(Lp // tile,),
+        in_specs=[
+            pl.BlockSpec((nq, m * ksub), lambda i: (0, 0)),
+            pl.BlockSpec((tile, m), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((nq, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((nq, Lp), jnp.float32),
+        interpret=interpret,
+    )(lut.reshape(nq, m * ksub), codes)
+    return out[:, :L]
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def adc_scan_pallas(lut, codes, tile: int = DEFAULT_TILE, interpret: bool = False):
+    """Per-query-list ADC scan (the IVF probe path).
+
+    lut: (nq, m, ksub) f32; codes: (nq, L, m) uint8 -> (nq, L) f32.
+    Grid over (query, candidate-tile); each step scores one query's tile
+    against that query's own LUT.
+    """
+    nq, m, ksub = lut.shape
+    L = codes.shape[1]
+    tile = min(tile, max(8, L))
+    Lp = -(-L // tile) * tile
+    if Lp != L:
+        codes = jnp.pad(codes, ((0, 0), (0, Lp - L), (0, 0)))
+
+    def kernel(lut_ref, codes_ref, out_ref):
+        # lut_ref: (1, m*ksub); codes_ref: (1, tile, m); out_ref: (1, 1, tile)
+        out_ref[0, :, :] = _adc_accumulate(m, ksub, lut_ref[:, :], codes_ref[0])
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nq, Lp // tile),
+        in_specs=[
+            pl.BlockSpec((1, m * ksub), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tile, m), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, tile), lambda i, j: (i, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((nq, 1, Lp), jnp.float32),
+        interpret=interpret,
+    )(lut.reshape(nq, m * ksub), codes)
+    return out[:, 0, :L]
+
+
+def adc_scan_shared_auto(lut, codes, tile: int = DEFAULT_TILE):
+    """Pallas on TPU, interpreter elsewhere (tests run the kernel on CPU)."""
+    return adc_scan_shared_pallas(lut, codes, tile=tile, interpret=not _on_tpu())
+
+
+def adc_scan_auto(lut, codes, tile: int = DEFAULT_TILE):
+    return adc_scan_pallas(lut, codes, tile=tile, interpret=not _on_tpu())

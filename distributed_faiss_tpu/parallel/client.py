@@ -316,6 +316,10 @@ class IndexClient:
             lambda idx: idx.set_omp_num_threads(num_threads), self.sub_indexes
         )
 
+    def get_perf_stats(self) -> list:
+        """Per-server RPC latency summaries (observability, SURVEY §5.1)."""
+        return self.pool.map(lambda idx: idx.get_perf_stats(), self.sub_indexes)
+
     def get_num_servers(self) -> int:
         return self.num_indexes
 

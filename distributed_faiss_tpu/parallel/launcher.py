@@ -1,0 +1,146 @@
+"""Cluster launch + discovery-file management.
+
+Parity with the reference's scripts/server_launcher.py: N servers, M per
+node, port = base_port + local_rank, each server appending
+``host,port`` to a shared discovery file whose first line is the expected
+server count (reference :59-68, :107-109), with an NFS-safe hardlink lock
+around the append (reference :23-56 uses the same hardlink trick).
+
+Backends:
+- ``local``  — N subprocesses on this host (the no-SLURM path the reference
+  lacks; used by tests and single-node deployments)
+- ``slurm``  — submitit AutoExecutor, gated on submitit being importable
+  (it is not baked into this image)
+"""
+
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+logger = logging.getLogger()
+
+
+# ------------------------------------------------------------- discovery file
+
+
+def write_discovery_header(path: str, num_servers: int) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(f"{num_servers}\n")
+
+
+def _lock_path(path: str) -> str:
+    return path + ".lock"
+
+
+def acquire_file_lock(path: str, timeout: float = 60.0) -> str:
+    """NFS-safe lock: hardlink creation is atomic on NFS (the same primitive
+    the reference's lockfile() uses)."""
+    lock = _lock_path(path)
+    unique = f"{lock}.{os.getpid()}.{time.monotonic_ns()}"
+    with open(unique, "w") as f:
+        f.write(str(os.getpid()))
+    deadline = time.time() + timeout
+    try:
+        while True:
+            try:
+                os.link(unique, lock)
+                return lock
+            except FileExistsError:
+                if time.time() > deadline:
+                    raise TimeoutError(f"could not acquire {lock}")
+                time.sleep(0.05)
+    finally:
+        os.unlink(unique)
+
+
+def release_file_lock(lock: str) -> None:
+    try:
+        os.unlink(lock)
+    except FileNotFoundError:
+        pass
+
+
+def append_discovery_entry(path: str, host: str, port: int) -> None:
+    lock = acquire_file_lock(path)
+    try:
+        with open(path, "a") as f:
+            f.write(f"{host},{port}\n")
+            f.flush()
+            os.fsync(f.fileno())
+    finally:
+        release_file_lock(lock)
+
+
+# ------------------------------------------------------------------ backends
+
+
+def run_server(rank: int, port: int, discovery_path: str, storage_dir: str,
+               load_index: bool = False, host: Optional[str] = None) -> None:
+    """Register in the discovery file, then serve forever (one rank)."""
+    import socket as socketmod
+
+    from distributed_faiss_tpu.parallel.server import IndexServer
+
+    host = host or socketmod.gethostname()
+    append_discovery_entry(discovery_path, host, port)
+    server = IndexServer(rank, storage_dir)
+    server.start_blocking(port, load_index=load_index)
+
+
+_CHILD_CODE = """
+import sys
+from distributed_faiss_tpu.parallel.launcher import run_server
+rank, port, disc, storage, load = sys.argv[1:6]
+run_server(int(rank), int(port), disc, storage, load == "1", host="localhost")
+"""
+
+
+def launch_local(num_servers: int, discovery_path: str, storage_dir: str,
+                 base_port: int = 12033, load_index: bool = False,
+                 env: Optional[dict] = None) -> List[subprocess.Popen]:
+    """Spawn num_servers subprocess ranks on this host."""
+    write_discovery_header(discovery_path, num_servers)
+    procs = []
+    for rank in range(num_servers):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CHILD_CODE, str(rank), str(base_port + rank),
+             discovery_path, storage_dir, "1" if load_index else "0"],
+            env={**os.environ, **(env or {})},
+        ))
+    return procs
+
+
+def launch_slurm(num_servers: int, num_servers_per_node: int, discovery_path: str,
+                 storage_dir: str, base_port: int = 12033, load_index: bool = False,
+                 partition: str = "learnlab", mem_gb: int = 400,
+                 timeout_min: int = 4320, log_dir: str = "slurm_logs"):
+    """SLURM launch via submitit (reference server_launcher.py:111-129)."""
+    try:
+        import submitit
+    except ImportError as e:  # pragma: no cover - submitit not in this image
+        raise RuntimeError(
+            "submitit is not installed; use launch_local or install submitit"
+        ) from e
+
+    write_discovery_header(discovery_path, num_servers)
+
+    def task():
+        env = submitit.JobEnvironment()
+        rank = env.global_rank
+        port = base_port + env.local_rank
+        run_server(rank, port, discovery_path, storage_dir, load_index)
+
+    executor = submitit.AutoExecutor(folder=log_dir)
+    executor.update_parameters(
+        nodes=-(-num_servers // num_servers_per_node),
+        tasks_per_node=num_servers_per_node,
+        slurm_partition=partition,
+        mem_gb=mem_gb,
+        timeout_min=timeout_min,
+        name="dft_index_server",
+    )
+    return executor.submit(task)

@@ -58,29 +58,38 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
 # --------------------------------------------------------------------- search
 
 
+def local_scan_merge(q_local, x_local, ntot_local, k: int, metric: str,
+                     chunk: int, axis: str = AXIS):
+    """Per-chip exact scan + ICI all_gather candidate merge.
+
+    The body of every sharded search: scan the local corpus block with the
+    chunked running-top-k kernel, offset local ids to global (contiguous
+    block layout: global id = shard * cap_local + pos), all_gather the
+    (nq, k) candidates over ``axis`` and merge. Used by _sharded_knn_jit and
+    the dryrun's 2D (dp, shard) variant."""
+    cap_local = x_local.shape[0]
+    vals, ids = distance._knn_scan(
+        q_local, x_local, ntot_local, k, metric, min(chunk, cap_local)
+    )
+    base_id = jax.lax.axis_index(axis).astype(jnp.int32) * cap_local
+    gids = jnp.where(ids >= 0, ids + base_id, ids)
+    av = jax.lax.all_gather(vals, axis)  # (S, nq, k)
+    ai = jax.lax.all_gather(gids, axis)
+    nq = q_local.shape[0]
+    flat_v = jnp.transpose(av, (1, 0, 2)).reshape(nq, -1)
+    flat_i = jnp.transpose(ai, (1, 0, 2)).reshape(nq, -1)
+    best, pos = jax.lax.top_k(flat_v, k)
+    return best, jnp.take_along_axis(flat_i, pos, axis=1)
+
+
 @functools.partial(
     jax.jit, static_argnames=("mesh", "k", "metric", "chunk")
 )
 def _sharded_knn_jit(q, x, ntotals, mesh, k: int, metric: str, chunk: int):
     """q replicated, x sharded (S*cap_local, d) along rows, ntotals (S,)."""
-    nshards = mesh.shape[AXIS]
-    cap_local = x.shape[0] // nshards
 
     def local(q, x_local, ntot_local):
-        # per-chip exact scan of the local corpus block
-        vals, ids = distance._knn_scan(
-            q, x_local, ntot_local[0], k, metric, min(chunk, cap_local)
-        )
-        base_id = jax.lax.axis_index(AXIS).astype(jnp.int32) * cap_local
-        gids = jnp.where(ids >= 0, ids + base_id, ids)
-        # ICI: gather every chip's (nq, k) candidates, merge replicated
-        av = jax.lax.all_gather(vals, AXIS)  # (S, nq, k)
-        ai = jax.lax.all_gather(gids, AXIS)
-        nq = q.shape[0]
-        flat_v = jnp.transpose(av, (1, 0, 2)).reshape(nq, -1)
-        flat_i = jnp.transpose(ai, (1, 0, 2)).reshape(nq, -1)
-        best, pos = jax.lax.top_k(flat_v, k)
-        return best, jnp.take_along_axis(flat_i, pos, axis=1)
+        return local_scan_merge(q, x_local, ntot_local[0], k, metric, chunk)
 
     # check_vma=False: the outputs ARE replicated (deterministic merge of
     # all_gather'ed candidates) but the static checker can't infer it
@@ -206,6 +215,15 @@ class ShardedFlatIndex(base.TpuIndex):
         self._dev = None       # (S * cap_local, d) sharded
         self._ntotals = None   # (S,) int32
         self._cap_local = 0
+        self._synced_n = 0     # rows already written to the device corpus
+        self._row_sharding = NamedSharding(self.mesh, P(AXIS, None))
+        self._append = jax.jit(
+            lambda data, block, start: jax.lax.dynamic_update_slice(
+                data, block, (start, 0)
+            ),
+            donate_argnums=(0,),
+            out_shardings=self._row_sharding,
+        )
 
     @property
     def is_trained(self) -> bool:
@@ -224,35 +242,43 @@ class ShardedFlatIndex(base.TpuIndex):
             return
         self._host_rows.append(x)
         self._n += x.shape[0]
-        self._dev = None  # lazy re-sync (bulk loads amortize the device_put)
+        # device sync is lazy and *incremental*: only new rows are written
+        # unless capacity must grow (geometric, so repacks are O(log n))
 
     def _host_array(self) -> np.ndarray:
         if len(self._host_rows) > 1:
             self._host_rows = [np.concatenate(self._host_rows)]
         return self._host_rows[0] if self._host_rows else np.zeros((0, self.dim), np.float32)
 
+    def _update_counts(self) -> None:
+        per = self._cap_local
+        counts = np.clip(self._n - np.arange(self.nshards) * per, 0, per)
+        self._ntotals = jax.device_put(
+            jnp.asarray(counts.astype(np.int32)), NamedSharding(self.mesh, P(AXIS))
+        )
+
     def _sync(self) -> None:
-        if self._dev is not None:
+        if self._synced_n == self._n and self._dev is not None:
             return
         rows = self._host_array()
         S = self.nshards
-        per = max(1, -(-self._n // S))
-        per = base._next_pow2(per, 8)
-        counts = np.zeros(S, np.int32)
-        packed = np.zeros((S, per, self.dim), np.float32)
-        # contiguous block partition: shard s owns rows [s*per, (s+1)*per)
-        for s in range(S):
-            blk = rows[s * per:(s + 1) * per]
-            packed[s, : blk.shape[0]] = blk
-            counts[s] = blk.shape[0]
-        self._cap_local = per
-        self._dev = jax.device_put(
-            jnp.asarray(packed.reshape(S * per, self.dim)),
-            NamedSharding(self.mesh, P(AXIS, None)),
-        )
-        self._ntotals = jax.device_put(
-            jnp.asarray(counts), NamedSharding(self.mesh, P(AXIS))
-        )
+        bucket = base._next_pow2(self._n - self._synced_n, base.DeviceVectorStore.WRITE_BUCKET)
+        if self._dev is None or self._n + bucket > S * self._cap_local:
+            # grow: full repack at the new power-of-two per-shard capacity
+            per = base._next_pow2(max(1, -(-(self._n + bucket) // S)), 8)
+            packed = np.zeros((S * per, self.dim), np.float32)
+            packed[: self._n] = rows  # contiguous layout: row i at flat pos i
+            self._cap_local = per
+            self._dev = jax.device_put(jnp.asarray(packed), self._row_sharding)
+        else:
+            # incremental append: one dynamic_update_slice of the new rows
+            block = np.zeros((bucket, self.dim), np.float32)
+            block[: self._n - self._synced_n] = rows[self._synced_n:self._n]
+            self._dev = self._append(
+                self._dev, jnp.asarray(block), jnp.asarray(self._synced_n, jnp.int32)
+            )
+        self._synced_n = self._n
+        self._update_counts()
 
     def search(self, q: np.ndarray, k: int):
         if self._n == 0:

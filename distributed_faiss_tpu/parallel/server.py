@@ -25,6 +25,7 @@ import pathlib
 import selectors
 import socket
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -33,6 +34,7 @@ from distributed_faiss_tpu.engine import Index
 from distributed_faiss_tpu.parallel import rpc
 from distributed_faiss_tpu.utils.config import IndexCfg
 from distributed_faiss_tpu.utils.state import IndexState
+from distributed_faiss_tpu.utils.tracing import LatencyStats
 
 logger = logging.getLogger()
 
@@ -45,6 +47,7 @@ class IndexServer:
         self.index_storage_dir = index_storage_dir
         self.socket: Optional[socket.socket] = None
         self._stopping = threading.Event()
+        self.perf = LatencyStats()  # per-RPC latency counters (SURVEY §5.1)
 
     # ------------------------------------------------------------ RPC surface
 
@@ -147,6 +150,10 @@ class IndexServer:
         # XLA owns device parallelism; keep the knob for host-side libs
         os.environ["OMP_NUM_THREADS"] = str(num_threads)
 
+    def get_perf_stats(self) -> dict:
+        """Per-RPC latency summary {method: {count, total_s, mean_s, max_s}}."""
+        return self.perf.summary()
+
     def stop(self) -> None:
         logger.info("stopping server rank=%d", self.rank)
         self._stopping.set()
@@ -213,7 +220,9 @@ class IndexServer:
             fn = getattr(self, fname)
             if fname.startswith("_"):
                 raise AttributeError(fname)
+            t0 = time.perf_counter()
             ret = fn(*args, **kwargs)
+            self.perf.record(fname, time.perf_counter() - t0)
             rpc.send_frame(conn, rpc.KIND_RESULT, ret)
         except Exception:
             import traceback

@@ -1,0 +1,67 @@
+"""Tracing / profiling / metrics.
+
+The reference has none of this beyond log lines (SURVEY §5.1); here:
+- ``LatencyStats``  — lock-protected per-operation latency counters; the
+  server records every RPC dispatch and exposes them via the
+  ``get_perf_stats`` RPC (observability the reference lacks).
+- ``traced``        — context manager stamping a jax.named_scope (visible in
+  xprof/tensorboard traces) and recording wall time into a LatencyStats.
+- ``profile_trace`` — wrapper around jax.profiler for capturing device
+  traces around a code block (TPU xprof dumps).
+"""
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional
+
+
+class LatencyStats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Dict[str, float]] = {}
+
+    def record(self, name: str, seconds: float) -> None:
+        with self._lock:
+            s = self._stats.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            s["count"] += 1
+            s["total_s"] += seconds
+            s["max_s"] = max(s["max_s"], seconds)
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            out = {}
+            for name, s in self._stats.items():
+                out[name] = dict(s)
+                out[name]["mean_s"] = s["total_s"] / max(s["count"], 1)
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+@contextlib.contextmanager
+def traced(name: str, stats: Optional[LatencyStats] = None):
+    """Named scope (xprof-friendly) + optional latency recording."""
+    import jax
+
+    t0 = time.perf_counter()
+    with jax.named_scope(name):
+        yield
+    if stats is not None:
+        stats.record(name, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a jax profiler trace (view with tensorboard/xprof)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

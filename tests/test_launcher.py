@@ -1,0 +1,91 @@
+"""Launcher + bulk loader + observability tests.
+
+Models the reference's L5 surface (scripts/server_launcher.py,
+scripts/load_data.py) using the local-subprocess backend.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.parallel import launcher
+
+
+def test_discovery_append_locking(tmp_path):
+    path = str(tmp_path / "disc.txt")
+    launcher.write_discovery_header(path, 16)
+    threads = [
+        threading.Thread(target=launcher.append_discovery_entry, args=(path, f"h{i}", 1000 + i))
+        for i in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = open(path).read().strip().splitlines()
+    assert lines[0] == "16"
+    entries = sorted(lines[1:])
+    assert len(entries) == 16 and len(set(entries)) == 16
+
+
+def test_file_lock_contention(tmp_path):
+    path = str(tmp_path / "f.txt")
+    open(path, "w").close()
+    lock = launcher.acquire_file_lock(path)
+    with pytest.raises(TimeoutError):
+        launcher.acquire_file_lock(path, timeout=0.3)
+    launcher.release_file_lock(lock)
+    lock2 = launcher.acquire_file_lock(path, timeout=1)
+    launcher.release_file_lock(lock2)
+
+
+@pytest.mark.slow
+def test_local_launch_end_to_end(tmp_path):
+    """Full L5 path: launch_local subprocesses -> client -> ingest -> search,
+    plus the bulk loader CLI against the same cluster."""
+    disc = str(tmp_path / "disc.txt")
+    storage = str(tmp_path / "storage")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+    procs = launcher.launch_local(2, disc, storage, base_port=13501, env=env)
+    try:
+        from distributed_faiss_tpu import IndexClient, IndexCfg, IndexState
+
+        # bulk loader CLI against the live cluster (memmap fp16 ingest)
+        mmap_path = str(tmp_path / "data.mmap")
+        rows, dim = 600, 16
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((rows, dim)).astype(np.float16)
+        np.memmap(mmap_path, dtype=np.float16, mode="w+", shape=(rows, dim))[:] = data
+
+        cfg = IndexCfg(index_builder_type="flat", dim=dim, metric="l2", train_num=100)
+        cfg_path = str(tmp_path / "cfg.json")
+        cfg.save(cfg_path)
+
+        out = subprocess.run(
+            [sys.executable, "scripts/load_data.py", "--data", mmap_path,
+             "--dtype", "fp16", "--dim", str(dim), "--bs", "100",
+             "--discovery", disc, "--index-id", "bulk", "--cfg", cfg_path],
+            env={**os.environ, **env}, cwd=repo_root,
+            capture_output=True, text=True, timeout=300,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+
+        client = IndexClient(disc)
+        client.cfg = cfg
+        assert client.get_ntotal("bulk") == rows
+        scores, meta = client.search(np.asarray(data[:3], np.float32), 4, "bulk")
+        assert meta[0][0] == 0 and meta[1][0] == 1  # integer-id metadata
+        # observability: per-RPC latency counters
+        stats = client.get_perf_stats()
+        assert len(stats) == 2
+        assert stats[0]["search"]["count"] >= 1
+        assert stats[0]["add_index_data"]["mean_s"] > 0
+        client.close()
+    finally:
+        for p in procs:
+            p.kill()
