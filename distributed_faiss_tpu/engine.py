@@ -134,6 +134,19 @@ class Index:
             if self.state in (IndexState.TRAINING, IndexState.TRAINED, IndexState.ADD):
                 return
             self.state = IndexState.TRAINING
+        try:
+            self._train_impl()
+        except BaseException:
+            # conscious fix vs the reference: a failed (possibly async)
+            # training run must not wedge the shard in TRAINING forever —
+            # reset so clients see NOT_TRAINED and the error can be retried
+            with self.index_lock:
+                if self.state == IndexState.TRAINING:
+                    self.state = IndexState.NOT_TRAINED
+            logger.exception("index training failed")
+            raise
+
+    def _train_impl(self) -> None:
         cfg = self.cfg
 
         with self.buffer_lock:

@@ -65,9 +65,20 @@ def _build_ivfsq(cfg: IndexCfg) -> IVFFlatIndex:
                         kmeans_iters=_kmeans_iters(cfg))
 
 
-def _build_hnswsq(cfg: IndexCfg) -> FlatIndex:
+def _build_hnswsq(cfg: IndexCfg):
     # reference asserts L2 (index.py:52)
     assert cfg.metric == "l2", "hnswsq only supports l2 metric"
+    from distributed_faiss_tpu.models import hnsw
+
+    if hnsw.native_available():
+        # defaults mirror the reference's hnswsq builder (index.py:55-58):
+        # store_n=128 graph degree, efConstruction=100
+        return hnsw.HNSWSQIndex(
+            cfg.dim, "l2",
+            M=int(cfg.extra.get("store_n", 128)),
+            ef_construction=int(cfg.extra.get("ef_construction", 100)),
+        )
+    # no C++ toolchain: exact sq8 scan keeps the builder slot working
     return FlatIndex(cfg.dim, "l2", codec="sq8")
 
 
@@ -170,11 +181,42 @@ def _sharded_flat_cls():
     return ShardedFlatIndex
 
 
+def _hnswsq_cls():
+    from distributed_faiss_tpu.models import hnsw
+
+    if hnsw.native_available():
+        return hnsw.HNSWSQIndex
+
+    class _HnswSqFallback:
+        """Restore an hnswsq shard on a host without a C++ toolchain: the
+        codes + codec in the state dict are exactly the sq8 flat layout, so
+        serve them with the exact scan (recall >= the graph's)."""
+
+        @staticmethod
+        def from_state_dict(state):
+            import jax.numpy as jnp
+            import numpy as np
+
+            idx = FlatIndex(int(state["dim"]), "l2", codec="sq8")
+            idx.sq_params = {
+                "vmin": jnp.asarray(state["sq_vmin"]),
+                "span": jnp.asarray(np.asarray(state["sq_step"]) * 255.0),
+            }
+            idx._trained = bool(state["trained"])
+            codes = np.asarray(state.get("codes", np.zeros((0, int(state["dim"])), np.uint8)))
+            if codes.shape[0]:
+                idx.store.add(codes)
+            return idx
+
+    return _HnswSqFallback
+
+
 _STATE_KINDS = {
     "flat": lambda: FlatIndex,
     "ivf_flat": lambda: IVFFlatIndex,
     "ivf_pq": lambda: IVFPQIndex,
     "sharded_flat": _sharded_flat_cls,
+    "hnswsq": _hnswsq_cls,
 }
 
 

@@ -1,0 +1,372 @@
+// HNSW graph index over SQ8-encoded vectors — the host-side native engine
+// behind the `hnswsq` builder (reference analog: faiss IndexHNSWSQ,
+// distributed_faiss/index.py:51-60). Graph traversal is pointer-chasing and
+// TPU-hostile, so this one index family runs on the host CPU; everything
+// else in the framework is XLA/Pallas.
+//
+// Clean-room implementation of the HNSW algorithm (Malkov & Yashunin):
+// geometric level assignment, greedy descent through upper layers, best-first
+// ef-bounded search on layer 0, bidirectional linking with closest-first
+// pruning. Distances are asymmetric: fp32 query vs uint8 codes dequantized
+// on the fly (d = sum_i (q_i - (vmin_i + c_i * step_i))^2, L2 only — the
+// reference asserts L2 for hnswsq too).
+//
+// C API at the bottom (ctypes-consumed by models/hnsw.py).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Neighbor {
+    float dist;
+    int id;
+};
+struct NearCmp {  // min-heap by distance
+    bool operator()(const Neighbor& a, const Neighbor& b) const { return a.dist > b.dist; }
+};
+struct FarCmp {  // max-heap by distance
+    bool operator()(const Neighbor& a, const Neighbor& b) const { return a.dist < b.dist; }
+};
+
+class HNSW {
+  public:
+    HNSW(int dim, int M, int ef_construction, unsigned seed)
+        : dim_(dim), M_(M), M0_(2 * M), efc_(ef_construction), rng_(seed),
+          ml_(1.0f / std::log(static_cast<float>(M))), entry_(-1), max_level_(-1) {
+        vmin_.assign(dim, 0.f);
+        step_.assign(dim, 1.f / 255.f);
+    }
+
+    void set_codec(const float* vmin, const float* step) {
+        std::copy(vmin, vmin + dim_, vmin_.begin());
+        std::copy(step, step + dim_, step_.begin());
+    }
+
+    int size() const { return static_cast<int>(levels_.size()); }
+
+    void add_batch(int n, const uint8_t* codes) {
+        for (int i = 0; i < n; ++i) insert(codes + static_cast<size_t>(i) * dim_);
+    }
+
+    void search(int nq, const float* q, int k, int ef,
+                float* out_d, int64_t* out_i) const {
+        for (int i = 0; i < nq; ++i) {
+            search_one(q + static_cast<size_t>(i) * dim_, k, ef,
+                       out_d + static_cast<size_t>(i) * k,
+                       out_i + static_cast<size_t>(i) * k);
+        }
+    }
+
+    bool save(const char* path) const;
+    static HNSW* load(const char* path);
+
+  private:
+    int dim_, M_, M0_, efc_;
+    std::mt19937 rng_;
+    float ml_;
+    int entry_, max_level_;
+    std::vector<float> vmin_, step_;
+    std::vector<uint8_t> codes_;           // n * dim
+    std::vector<int> levels_;              // per node
+    std::vector<std::vector<int>> links0_; // layer-0 adjacency per node
+    // upper layers: upper_[node] has (level) adjacency lists, 1-indexed by
+    // layer (upper_[v][l-1] = neighbors of v at layer l); only nodes with
+    // level >= 1 have entries
+    std::vector<std::vector<std::vector<int>>> upper_;
+    mutable std::vector<uint32_t> visited_;
+    mutable uint32_t epoch_ = 0;
+
+    float dist(const float* q, int b) const {
+        const uint8_t* c = codes_.data() + static_cast<size_t>(b) * dim_;
+        float acc = 0.f;
+        for (int i = 0; i < dim_; ++i) {
+            float v = vmin_[i] + c[i] * step_[i];
+            float t = q[i] - v;
+            acc += t * t;
+        }
+        return acc;
+    }
+
+    void decode(int b, float* out) const {
+        const uint8_t* c = codes_.data() + static_cast<size_t>(b) * dim_;
+        for (int i = 0; i < dim_; ++i) out[i] = vmin_[i] + c[i] * step_[i];
+    }
+
+    const std::vector<int>& neighbors(int v, int level) const {
+        return level == 0 ? links0_[v] : upper_[v][level - 1];
+    }
+    std::vector<int>& neighbors(int v, int level) {
+        return level == 0 ? links0_[v] : upper_[v][level - 1];
+    }
+
+    // best-first search at one layer; returns up to ef closest as a sorted
+    // (ascending) vector
+    std::vector<Neighbor> search_layer(const float* q, int entry, float entry_d,
+                                       int ef, int level) const {
+        if (++epoch_ == 0) {  // wrapped: clear and restart
+            std::fill(visited_.begin(), visited_.end(), 0u);
+            epoch_ = 1;
+        }
+        if (visited_.size() < levels_.size()) visited_.resize(levels_.size(), 0u);
+
+        std::priority_queue<Neighbor, std::vector<Neighbor>, NearCmp> cand;
+        std::priority_queue<Neighbor, std::vector<Neighbor>, FarCmp> result;
+        cand.push({entry_d, entry});
+        result.push({entry_d, entry});
+        visited_[entry] = epoch_;
+
+        while (!cand.empty()) {
+            Neighbor cur = cand.top();
+            if (cur.dist > result.top().dist && static_cast<int>(result.size()) >= ef)
+                break;
+            cand.pop();
+            for (int nb : neighbors(cur.id, level)) {
+                if (visited_[nb] == epoch_) continue;
+                visited_[nb] = epoch_;
+                float d = dist(q, nb);
+                if (static_cast<int>(result.size()) < ef || d < result.top().dist) {
+                    cand.push({d, nb});
+                    result.push({d, nb});
+                    if (static_cast<int>(result.size()) > ef) result.pop();
+                }
+            }
+        }
+        std::vector<Neighbor> out(result.size());
+        for (size_t i = result.size(); i-- > 0;) {
+            out[i] = result.top();
+            result.pop();
+        }
+        return out;
+    }
+
+    int greedy_descend(const float* q, int from_level, int to_level,
+                       int entry, float* d_io) const {
+        int cur = entry;
+        float cur_d = *d_io;
+        for (int l = from_level; l > to_level; --l) {
+            bool improved = true;
+            while (improved) {
+                improved = false;
+                for (int nb : neighbors(cur, l)) {
+                    float d = dist(q, nb);
+                    if (d < cur_d) {
+                        cur_d = d;
+                        cur = nb;
+                        improved = true;
+                    }
+                }
+            }
+        }
+        *d_io = cur_d;
+        return cur;
+    }
+
+    // closest-first pruning to cap (simple variant of the paper's heuristic)
+    void prune(std::vector<Neighbor>& cands, int cap) const {
+        std::sort(cands.begin(), cands.end(),
+                  [](const Neighbor& a, const Neighbor& b) { return a.dist < b.dist; });
+        if (static_cast<int>(cands.size()) > cap) cands.resize(cap);
+    }
+
+    void insert(const uint8_t* code) {
+        int id = size();
+        codes_.insert(codes_.end(), code, code + dim_);
+        std::uniform_real_distribution<float> uni(1e-9f, 1.0f);
+        int level = static_cast<int>(-std::log(uni(rng_)) * ml_);
+        levels_.push_back(level);
+        links0_.emplace_back();
+        upper_.emplace_back();
+        upper_.back().resize(level > 0 ? level : 0);
+
+        std::vector<float> qf(dim_);
+        decode(id, qf.data());
+        const float* q = qf.data();
+
+        if (entry_ < 0) {
+            entry_ = id;
+            max_level_ = level;
+            return;
+        }
+
+        float d = dist(q, entry_);
+        int cur = greedy_descend(q, max_level_, std::min(level, max_level_), entry_, &d);
+
+        for (int l = std::min(level, max_level_); l >= 0; --l) {
+            auto found = search_layer(q, cur, d, efc_, l);
+            int cap = (l == 0) ? M0_ : M_;
+            std::vector<Neighbor> sel(found);
+            prune(sel, M_);
+            auto& my = neighbors(id, l);
+            for (const auto& nb : sel) {
+                my.push_back(nb.id);
+                auto& theirs = neighbors(nb.id, l);
+                theirs.push_back(id);
+                if (static_cast<int>(theirs.size()) > cap) {
+                    // re-rank their links from their own viewpoint
+                    std::vector<float> nbf(dim_);
+                    decode(nb.id, nbf.data());
+                    std::vector<Neighbor> rel;
+                    rel.reserve(theirs.size());
+                    for (int t : theirs) rel.push_back({dist(nbf.data(), t), t});
+                    prune(rel, cap);
+                    theirs.clear();
+                    for (const auto& r : rel) theirs.push_back(r.id);
+                }
+            }
+            if (!found.empty()) {
+                cur = found[0].id;
+                d = found[0].dist;
+            }
+        }
+        if (level > max_level_) {
+            max_level_ = level;
+            entry_ = id;
+        }
+    }
+
+    void search_one(const float* q, int k, int ef, float* out_d, int64_t* out_i) const {
+        if (entry_ < 0) {
+            for (int i = 0; i < k; ++i) {
+                out_d[i] = HUGE_VALF;
+                out_i[i] = -1;
+            }
+            return;
+        }
+        float d = dist(q, entry_);
+        int cur = greedy_descend(q, max_level_, 0, entry_, &d);
+        auto found = search_layer(q, cur, d, std::max(ef, k), 0);
+        int n = std::min<int>(k, found.size());
+        for (int i = 0; i < n; ++i) {
+            out_d[i] = found[i].dist;
+            out_i[i] = found[i].id;
+        }
+        for (int i = n; i < k; ++i) {
+            out_d[i] = HUGE_VALF;
+            out_i[i] = -1;
+        }
+    }
+};
+
+// ---------------------------------------------------------------- serialization
+
+template <typename T>
+void wr(FILE* f, const T& v) { std::fwrite(&v, sizeof(T), 1, f); }
+template <typename T>
+bool rd(FILE* f, T* v) { return std::fread(v, sizeof(T), 1, f) == 1; }
+
+void wr_vec_i(FILE* f, const std::vector<int>& v) {
+    int64_t n = v.size();
+    wr(f, n);
+    if (n) std::fwrite(v.data(), sizeof(int), n, f);
+}
+bool rd_vec_i(FILE* f, std::vector<int>* v) {
+    int64_t n;
+    if (!rd(f, &n)) return false;
+    v->resize(n);
+    return n == 0 || std::fread(v->data(), sizeof(int), n, f) == static_cast<size_t>(n);
+}
+
+bool HNSW::save(const char* path) const {
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return false;
+    const uint32_t magic = 0x44465448;  // "DFTH"
+    wr(f, magic);
+    wr(f, dim_); wr(f, M_); wr(f, M0_); wr(f, efc_);
+    wr(f, entry_); wr(f, max_level_); wr(f, ml_);
+    int64_t n = size();
+    wr(f, n);
+    std::fwrite(vmin_.data(), sizeof(float), dim_, f);
+    std::fwrite(step_.data(), sizeof(float), dim_, f);
+    if (n) {
+        std::fwrite(codes_.data(), 1, codes_.size(), f);
+        std::fwrite(levels_.data(), sizeof(int), n, f);
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        wr_vec_i(f, links0_[i]);
+        int32_t nl = upper_[i].size();
+        wr(f, nl);
+        for (const auto& lv : upper_[i]) wr_vec_i(f, lv);
+    }
+    std::fclose(f);
+    return true;
+}
+
+HNSW* HNSW::load(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return nullptr;
+    uint32_t magic;
+    int dim, M, M0, efc, entry, max_level;
+    float ml;
+    int64_t n;
+    if (!rd(f, &magic) || magic != 0x44465448 || !rd(f, &dim) || !rd(f, &M) ||
+        !rd(f, &M0) || !rd(f, &efc) || !rd(f, &entry) || !rd(f, &max_level) ||
+        !rd(f, &ml) || !rd(f, &n)) {
+        std::fclose(f);
+        return nullptr;
+    }
+    HNSW* h = new HNSW(dim, M, efc, 0);
+    h->M0_ = M0;
+    h->entry_ = entry;
+    h->max_level_ = max_level;
+    h->ml_ = ml;
+    bool ok = std::fread(h->vmin_.data(), sizeof(float), dim, f) == static_cast<size_t>(dim)
+           && std::fread(h->step_.data(), sizeof(float), dim, f) == static_cast<size_t>(dim);
+    h->codes_.resize(static_cast<size_t>(n) * dim);
+    h->levels_.resize(n);
+    if (ok && n) {
+        ok = std::fread(h->codes_.data(), 1, h->codes_.size(), f) == h->codes_.size()
+          && std::fread(h->levels_.data(), sizeof(int), n, f) == static_cast<size_t>(n);
+    }
+    h->links0_.resize(n);
+    h->upper_.resize(n);
+    for (int64_t i = 0; ok && i < n; ++i) {
+        ok = rd_vec_i(f, &h->links0_[i]);
+        int32_t nl = 0;
+        ok = ok && rd(f, &nl);
+        if (ok) {
+            h->upper_[i].resize(nl);
+            for (int32_t l = 0; ok && l < nl; ++l) ok = rd_vec_i(f, &h->upper_[i][l]);
+        }
+    }
+    std::fclose(f);
+    if (!ok) {
+        delete h;
+        return nullptr;
+    }
+    return h;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------- C API
+
+extern "C" {
+
+void* dft_hnsw_create(int dim, int M, int ef_construction, unsigned seed) {
+    return new HNSW(dim, M, ef_construction, seed);
+}
+void dft_hnsw_free(void* h) { delete static_cast<HNSW*>(h); }
+void dft_hnsw_set_codec(void* h, const float* vmin, const float* step) {
+    static_cast<HNSW*>(h)->set_codec(vmin, step);
+}
+void dft_hnsw_add(void* h, int n, const uint8_t* codes) {
+    static_cast<HNSW*>(h)->add_batch(n, codes);
+}
+int dft_hnsw_size(void* h) { return static_cast<HNSW*>(h)->size(); }
+void dft_hnsw_search(void* h, int nq, const float* q, int k, int ef,
+                     float* out_d, int64_t* out_i) {
+    static_cast<HNSW*>(h)->search(nq, q, k, ef, out_d, out_i);
+}
+int dft_hnsw_save(void* h, const char* path) {
+    return static_cast<HNSW*>(h)->save(path) ? 1 : 0;
+}
+void* dft_hnsw_load(const char* path) { return HNSW::load(path); }
+
+}  // extern "C"
