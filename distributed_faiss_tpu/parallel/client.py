@@ -320,6 +320,27 @@ class IndexClient:
         """Per-server RPC latency summaries (observability, SURVEY §5.1)."""
         return self.pool.map(lambda idx: idx.get_perf_stats(), self.sub_indexes)
 
+    def ping(self, timeout: float = 10.0) -> list:
+        """Health-check every server; returns per-server dicts or the error
+        for dead/hung ones. A per-call socket deadline enforces the
+        no-hang guarantee even for a SIGSTOP'd-but-connected server (the
+        stub's connection is closed on expiry — a later retry reconnects
+        via a fresh IndexClient)."""
+
+        def one(idx):
+            try:
+                return idx.generic_fun("ping", (), {}, timeout=timeout)
+            except Exception as e:  # dead/unreachable/hung server
+                return {
+                    "rank": None,
+                    "server": idx.id,
+                    "host": idx.host,
+                    "port": idx.port,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+
+        return self.pool.map(one, self.sub_indexes)
+
     def get_num_servers(self) -> int:
         return self.num_indexes
 

@@ -184,10 +184,23 @@ class Client:
         self._lock = threading.Lock()
         self._closed = False
 
-    def generic_fun(self, fname: str, args=(), kwargs=None):
+    def generic_fun(self, fname: str, args=(), kwargs=None, timeout: float = None):
+        """Remote call. With ``timeout``, the socket gets a deadline for this
+        call; on expiry the connection is closed (a partial frame would
+        desync the stream) and socket.timeout propagates."""
         with self._lock:
-            send_frame(self.sock, KIND_CALL, (fname, tuple(args), kwargs or {}))
-            kind, payload = recv_frame(self.sock)
+            if timeout is not None:
+                self.sock.settimeout(timeout)
+            try:
+                send_frame(self.sock, KIND_CALL, (fname, tuple(args), kwargs or {}))
+                kind, payload = recv_frame(self.sock)
+            except (socket.timeout, TimeoutError):
+                self._closed = True
+                self.sock.close()
+                raise
+            finally:
+                if timeout is not None and not self._closed:
+                    self.sock.settimeout(None)
         if kind == KIND_RESULT:
             return payload
         if kind == KIND_ERROR:

@@ -154,6 +154,16 @@ class IndexServer:
         """Per-RPC latency summary {method: {count, total_s, mean_s, max_s}}."""
         return self.perf.summary()
 
+    def ping(self) -> dict:
+        """Liveness/health probe (the reference has no failure detection
+        beyond startup backoff, SURVEY §5.3). get_state() runs outside
+        indexes_lock so a long device call on one index can't stall the
+        registry (and with it every other RPC)."""
+        with self.indexes_lock:
+            snapshot = list(self.indexes.items())
+        states = {iid: idx.get_state().name for iid, idx in snapshot}
+        return {"rank": self.rank, "indexes": states}
+
     def stop(self) -> None:
         logger.info("stopping server rank=%d", self.rank)
         self._stopping.set()

@@ -291,6 +291,21 @@ def test_selector_server_mode(tmp_path, rng):
     client.close()
 
 
+def test_ping_health(cluster, rng, request):
+    index_id = request.node.name
+    client = IndexClient(cluster["multi"])
+    client.create_index(index_id, flat_cfg(train_num=10))
+    client.add_index_data(index_id, rng.standard_normal((20, 16)).astype(np.float32), None)
+    client.sync_train(index_id)
+    assert wait_trained(client, index_id)
+    health = client.ping()
+    assert len(health) == 4
+    assert sorted(h["rank"] for h in health) == [0, 1, 2, 3]
+    # every server must report the index (add only hit one, create hit all)
+    assert all(h["indexes"].get(index_id) == "TRAINED" for h in health)
+    client.close()
+
+
 def test_missing_index_raises_server_exception(cluster):
     client = IndexClient(cluster["multi"])
     # no cfg yet: the client itself refuses to merge-search
