@@ -139,34 +139,52 @@ class PaddedLists:
         self.ids = jnp.pad(self.ids, [(0, 0), (0, newcap - self.cap)], constant_values=-1)
         self.cap = newcap
 
+    @staticmethod
+    def plan_append(list_idx, payload, gids, nlist, cap, sizes_host, payload_shape,
+                    dtype, slot_fn, drop_value, bucket_min):
+        """Host-side offset planning shared by local and mesh-sharded lists.
+
+        Sorts the batch by target list, computes each row's write position
+        ``slot_fn(list) * cap + current_size + within-batch-offset``, and
+        pads everything to a power-of-two bucket (padding rows get
+        ``drop_value`` so the device scatter drops them). Returns
+        (counts, pos, payload, gids) with the latter three bucket-padded.
+        """
+        n = list_idx.shape[0]
+        counts = np.bincount(list_idx, minlength=nlist)
+        order = np.argsort(list_idx, kind="stable")
+        sorted_li = list_idx[order]
+        group_start = np.zeros(nlist + 1, np.int64)
+        group_start[1:] = np.cumsum(counts)
+        offs = np.arange(n, dtype=np.int64) - group_start[sorted_li]
+        pos = slot_fn(sorted_li.astype(np.int64)) * cap + sizes_host[sorted_li] + offs
+
+        bucket = _next_pow2(n, bucket_min)
+        pos_b = np.full(bucket, drop_value, np.int64)
+        pay_b = np.zeros((bucket,) + payload_shape, dtype)
+        gid_b = np.zeros(bucket, np.int32)
+        pos_b[:n] = pos
+        pay_b[:n] = payload[order]
+        gid_b[:n] = gids[order]
+        return counts, pos_b, pay_b, gid_b
+
     def append(self, list_idx: np.ndarray, payload: np.ndarray, gids: np.ndarray):
         """Append payload rows to their assigned lists.
 
         list_idx: (n,) int; payload: (n, *payload_shape); gids: (n,) global ids.
         Offset planning is host-side numpy; the device side is one scatter.
         """
-        n = list_idx.shape[0]
-        if n == 0:
+        if list_idx.shape[0] == 0:
             return
         counts = np.bincount(list_idx, minlength=self.nlist)
         new_sizes = self.sizes_host + counts
         if new_sizes.max() > self.cap:
             self._grow(int(new_sizes.max()))
-
-        order = np.argsort(list_idx, kind="stable")
-        sorted_li = list_idx[order]
-        group_start = np.zeros(self.nlist + 1, np.int64)
-        group_start[1:] = np.cumsum(counts)
-        offs = np.arange(n, dtype=np.int64) - group_start[sorted_li]
-        pos = sorted_li.astype(np.int64) * self.cap + self.sizes_host[sorted_li] + offs
-
-        bucket = _next_pow2(n, self.APPEND_BUCKET)
-        pos_b = np.full(bucket, np.iinfo(np.int32).max, np.int64)  # dropped
-        pay_b = np.zeros((bucket,) + self.payload_shape, self.dtype)
-        gid_b = np.zeros(bucket, np.int32)
-        pos_b[:n] = pos
-        pay_b[:n] = payload[order]
-        gid_b[:n] = gids[order]
+        counts, pos_b, pay_b, gid_b = self.plan_append(
+            list_idx, payload, gids, self.nlist, self.cap, self.sizes_host,
+            self.payload_shape, self.dtype, lambda l: l,
+            np.iinfo(np.int32).max, self.APPEND_BUCKET,
+        )
 
         flat_data = self.data.reshape((self.nlist * self.cap,) + self.payload_shape)
         flat_ids = self.ids.reshape(self.nlist * self.cap)

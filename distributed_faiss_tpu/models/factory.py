@@ -84,10 +84,16 @@ def _build_hnswsq(cfg: IndexCfg):
 
 def _build_ivf_tpu(cfg: IndexCfg):
     # lazy import: mesh pulls in jax.sharding machinery only when used
-    from distributed_faiss_tpu.parallel.mesh import IvfTpuIndex, make_mesh
+    from distributed_faiss_tpu.parallel.mesh import (
+        IvfTpuIndex, ShardedIVFFlatIndex, make_mesh,
+    )
 
     n_dev = cfg.extra.get("mesh_devices")
     mesh = make_mesh(int(n_dev)) if n_dev else None
+    if cfg.extra.get("shard_lists"):
+        # full multi-chip path: inverted lists partitioned across the mesh
+        return ShardedIVFFlatIndex(cfg.dim, _centroids(cfg), cfg.get_metric(),
+                                   mesh=mesh, kmeans_iters=_kmeans_iters(cfg))
     return IvfTpuIndex(cfg.dim, _centroids(cfg), cfg.get_metric(), "f32",
                        mesh=mesh, kmeans_iters=_kmeans_iters(cfg))
 
@@ -211,11 +217,18 @@ def _hnswsq_cls():
     return _HnswSqFallback
 
 
+def _sharded_ivf_cls():
+    from distributed_faiss_tpu.parallel.mesh import ShardedIVFFlatIndex
+
+    return ShardedIVFFlatIndex
+
+
 _STATE_KINDS = {
     "flat": lambda: FlatIndex,
     "ivf_flat": lambda: IVFFlatIndex,
     "ivf_pq": lambda: IVFPQIndex,
     "sharded_flat": _sharded_flat_cls,
+    "sharded_ivf_flat": _sharded_ivf_cls,
     "hnswsq": _hnswsq_cls,
 }
 

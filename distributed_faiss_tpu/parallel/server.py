@@ -285,3 +285,24 @@ class IndexServer:
         if not base:
             return os.path.join(self.index_storage_dir, index_id, str(self.rank))
         return os.path.join(base, str(self.rank))
+
+
+def main(argv=None):
+    """Standalone single-server CLI (the reference ships a broken main() —
+    server.py:391-400 constructs IndexServer() with no args; ours works)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="run one index server rank")
+    parser.add_argument("--port", default=rpc.DEFAULT_PORT, type=int)
+    parser.add_argument("--rank", default=0, type=int)
+    parser.add_argument("--storage-dir", required=True)
+    parser.add_argument("--ipv6", action="store_true")
+    parser.add_argument("--load-index", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = IndexServer(args.rank, args.storage_dir)
+    server.start_blocking(args.port, v6=args.ipv6, load_index=args.load_index)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,7 +13,9 @@ import numpy as np
 _META_KEY = "__meta__"
 
 
-def save_state(path: str, state: dict) -> None:
+def save_state(path_or_file, state: dict) -> None:
+    """Write a state dict to a path or an already-open binary file object
+    (the engine passes a tmp file for atomic rename-into-place saves)."""
     arrays = {}
     scalars = {}
     for k, v in state.items():
@@ -22,8 +24,11 @@ def save_state(path: str, state: dict) -> None:
         else:
             scalars[k] = v
     arrays[_META_KEY] = np.frombuffer(json.dumps(scalars).encode("utf-8"), dtype=np.uint8)
-    with open(path, "wb") as f:
-        np.savez(f, **arrays)
+    if hasattr(path_or_file, "write"):
+        np.savez(path_or_file, **arrays)
+    else:
+        with open(path_or_file, "wb") as f:
+            np.savez(f, **arrays)
 
 
 def load_state(path: str) -> dict:

@@ -1,0 +1,132 @@
+"""Sharded-inverted-list IVF tests on the virtual 8-device mesh."""
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.models.ivf import IVFFlatIndex
+from distributed_faiss_tpu.parallel.mesh import ShardedIVFFlatIndex, ShardedPaddedLists, make_mesh
+
+
+def brute_ids(q, x, k, metric):
+    if metric == "dot":
+        s = q @ x.T
+    else:
+        s = -((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    return np.argsort(-s, axis=1)[:, :k]
+
+
+def test_sharded_lists_bookkeeping(rng):
+    m = make_mesh()
+    lists = ShardedPaddedLists(10, (4,), np.float32, m, min_cap=8)
+    li = rng.integers(0, 10, 50).astype(np.int64)
+    rows = rng.standard_normal((50, 4)).astype(np.float32)
+    lists.append(li, rows, np.arange(50, dtype=np.int64))
+    assert lists.ntotal == 50
+    np.testing.assert_array_equal(lists.sizes_host, np.bincount(li, minlength=10))
+    # every appended row is present exactly once under its list's slot
+    data = np.asarray(lists.data)
+    ids = np.asarray(lists.ids)
+    seen = ids[ids >= 0]
+    assert sorted(seen.tolist()) == list(range(50))
+    for g in range(50):
+        slot = int(lists.slot_of(li[g]))
+        row_pos = np.where(ids[slot] == g)[0]
+        assert row_pos.size == 1
+        np.testing.assert_allclose(data[slot, row_pos[0]], rows[g], rtol=1e-6)
+
+
+def test_sharded_lists_growth(rng):
+    m = make_mesh()
+    lists = ShardedPaddedLists(4, (2,), np.float32, m, min_cap=8)
+    for batch in range(4):
+        li = np.zeros(16, np.int64)  # hammer one list to force growth
+        rows = rng.standard_normal((16, 2)).astype(np.float32)
+        lists.append(li, rows, np.arange(batch * 16, batch * 16 + 16, dtype=np.int64))
+    assert lists.cap >= 64
+    ids = np.asarray(lists.ids)
+    assert sorted(ids[ids >= 0].tolist()) == list(range(64))
+
+
+@pytest.mark.parametrize("metric", ["dot", "l2"])
+def test_sharded_ivf_full_probe_exact(rng, metric):
+    """nprobe == nlist: sharded IVF must equal brute force exactly."""
+    x = rng.standard_normal((1500, 16)).astype(np.float32)
+    q = rng.standard_normal((6, 16)).astype(np.float32)
+    idx = ShardedIVFFlatIndex(16, 8, metric)
+    idx.train(x[:800])
+    idx.add(x[:700])
+    idx.add(x[700:])
+    idx.set_nprobe(8)
+    D, I = idx.search(q, 10)
+    wi = brute_ids(q, x, 10, metric)
+    np.testing.assert_array_equal(I, wi)
+
+
+def test_sharded_ivf_matches_single_device(rng):
+    """Same data, same centroids count: sharded and single-device IVF agree
+    at full probe."""
+    x = rng.standard_normal((2000, 16)).astype(np.float32)
+    q = rng.standard_normal((8, 16)).astype(np.float32)
+    sharded = ShardedIVFFlatIndex(16, 8, "l2")
+    sharded.train(x)
+    sharded.add(x)
+    sharded.set_nprobe(8)
+    single = IVFFlatIndex(16, 8, "l2")
+    single.train(x)
+    single.add(x)
+    single.set_nprobe(8)
+    Ds, Is = sharded.search(q, 10)
+    Du, Iu = single.search(q, 10)
+    np.testing.assert_array_equal(Is, Iu)
+    np.testing.assert_allclose(Ds, Du, rtol=1e-3, atol=1e-3)
+
+
+def test_sharded_ivf_partial_probe_recall(rng):
+    x = rng.standard_normal((3000, 16)).astype(np.float32)
+    q = rng.standard_normal((10, 16)).astype(np.float32)
+    idx = ShardedIVFFlatIndex(16, 16, "l2")
+    idx.train(x)
+    idx.add(x)
+    idx.set_nprobe(8)
+    D, I = idx.search(q, 10)
+    wi = brute_ids(q, x, 10, "l2")
+    recall = np.mean([len(set(I[i]) & set(wi[i])) / 10 for i in range(10)])
+    assert recall > 0.6
+
+
+def test_sharded_ivf_state_round_trip(rng, tmp_path):
+    from distributed_faiss_tpu.models.factory import index_from_state_dict
+    from distributed_faiss_tpu.utils.serialization import load_state, save_state
+
+    x = rng.standard_normal((900, 8)).astype(np.float32)
+    q = rng.standard_normal((4, 8)).astype(np.float32)
+    idx = ShardedIVFFlatIndex(8, 4, "l2")
+    idx.train(x)
+    idx.add(x)
+    idx.set_nprobe(4)
+    D0, I0 = idx.search(q, 6)
+    p = str(tmp_path / "sivf.npz")
+    save_state(p, idx.state_dict())
+    # through the registry — the engine/server restore path
+    idx2 = index_from_state_dict(load_state(p))
+    assert isinstance(idx2, ShardedIVFFlatIndex)
+    D1, I1 = idx2.search(q, 6)
+    np.testing.assert_array_equal(I0, I1)
+    # reconstruct path inherited from IVFFlat host mirrors
+    rec = idx2.reconstruct_batch(I1[0][:3])
+    np.testing.assert_allclose(rec, x[I1[0][:3]], rtol=1e-5)
+
+
+def test_ivf_tpu_shard_lists_builder(rng):
+    from distributed_faiss_tpu.models.factory import build_index
+    from distributed_faiss_tpu.utils.config import IndexCfg
+
+    cfg = IndexCfg(index_builder_type="ivf_tpu", dim=8, metric="l2",
+                   centroids=4, nprobe=4, shard_lists=True)
+    idx = build_index(cfg)
+    assert isinstance(idx, ShardedIVFFlatIndex)
+    x = rng.standard_normal((600, 8)).astype(np.float32)
+    idx.train(x)
+    idx.add(x)
+    D, I = idx.search(x[:3], 4)
+    assert (I[:, 0] == np.arange(3)).all()
