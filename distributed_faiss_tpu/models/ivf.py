@@ -178,7 +178,10 @@ class _IVFBase(base.TpuIndex):
             return None
         return np.asarray(self.centroids)
 
-    def _assign_host(self, x: np.ndarray, chunk: int = 4096) -> np.ndarray:
+    def _assign_host(self, x: np.ndarray, chunk: int = None) -> np.ndarray:
+        # bound the (chunk, nlist) fp32 score block — a fixed chunk would
+        # blow up at the 65k/262k centroid tiers
+        chunk = kmeans.auto_chunk(self.nlist, chunk)
         out = np.empty(x.shape[0], np.int64)
         for s in range(0, x.shape[0], chunk):
             out[s : s + chunk] = np.asarray(

@@ -125,17 +125,29 @@ def _use_pp(k: int, init: str) -> bool:
     return k <= 16384
 
 
-def kmeans(x, k: int, iters: int = 20, seed: int = 0, chunk: int = 8192, init: str = "auto"):
+_CHUNK_BYTE_BUDGET = 512 * 1024 * 1024
+
+
+def auto_chunk(k: int, requested: int = None) -> int:
+    """Bound the (chunk, k) fp32 assignment block to the byte budget — at
+    the 65536/262144-centroid tiers a fixed 8192-row chunk would allocate
+    2-8 GB per scan step."""
+    if requested is not None:
+        return requested
+    return max(256, min(8192, _CHUNK_BYTE_BUDGET // (4 * max(k, 1))))
+
+
+def kmeans(x, k: int, iters: int = 20, seed: int = 0, chunk: int = None, init: str = "auto"):
     """L2 Lloyd k-means. x: (n, d) -> centroids (k, d) fp32.
 
-    ``chunk`` bounds the (chunk, k) distance block; n is padded to a chunk
-    multiple with masked rows.
+    ``chunk`` bounds the (chunk, k) distance block (auto-sized from k when
+    omitted); n is padded to a chunk multiple with masked rows.
     """
     x = jnp.asarray(x, jnp.float32)
     n, d = x.shape
     if k > n:
         raise ValueError(f"k={k} > n={n} training points")
-    chunk = min(chunk, max(8, n))
+    chunk = min(auto_chunk(k, chunk), max(8, n))
     npad = ((n + chunk - 1) // chunk) * chunk
     mask = jnp.arange(npad) < n
     if npad != n:
@@ -145,7 +157,7 @@ def kmeans(x, k: int, iters: int = 20, seed: int = 0, chunk: int = 8192, init: s
 
 
 def kmeans_batched(
-    xs, k: int, iters: int = 20, seed: int = 0, chunk: int = 4096, init: str = "auto"
+    xs, k: int, iters: int = 20, seed: int = 0, chunk: int = None, init: str = "auto"
 ):
     """Batched independent k-means over the leading axis (PQ codebooks).
 
@@ -156,7 +168,7 @@ def kmeans_batched(
     m, n, dsub = xs.shape
     if k > n:
         raise ValueError(f"k={k} > n={n} training points")
-    chunk = min(chunk, max(8, n))
+    chunk = min(auto_chunk(k * m, chunk), max(8, n))
     npad = ((n + chunk - 1) // chunk) * chunk
     mask = jnp.arange(npad) < n
     if npad != n:

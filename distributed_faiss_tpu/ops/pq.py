@@ -39,14 +39,36 @@ def pq_train(x, m: int, nbits: int = 8, iters: int = 20, seed: int = 0):
 
 
 @jax.jit
-def pq_encode(x, codebooks):
-    """x: (n, d), codebooks: (m, ksub, dsub) -> codes (n, m) uint8."""
+def _pq_encode_block(x, codebooks):
     m = codebooks.shape[0]
     xs = _split(jnp.asarray(x, jnp.float32), m)  # (m, n, dsub)
     cn = jnp.sum(codebooks * codebooks, axis=2)  # (m, ksub)
     ip = jnp.einsum("mnd,mkd->mnk", xs, codebooks, precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32)
     d2 = cn[:, None, :] - 2.0 * ip  # ||x||^2 constant per row — argmin-invariant
     return jnp.argmin(d2, axis=2).T.astype(jnp.uint8)  # (n, m)
+
+
+def pq_encode(x, codebooks, block: int = 8192):
+    """x: (n, d), codebooks: (m, ksub, dsub) -> codes (n, m) uint8.
+
+    Row-blocked: the (m, block, ksub) distance transient is ~0.5 GB at
+    m=64/block=8192 — without blocking a default 50k-row buffer_bsz add
+    would materialize >3 GB per encode."""
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[0]
+    if n <= block:
+        return _pq_encode_block(x, codebooks)
+    out = []
+    for s in range(0, n, block):
+        xb = x[s:s + block]
+        if xb.shape[0] < block:
+            # pad the tail to the fixed block shape: one compiled program
+            # total instead of one per distinct tail size
+            pad = block - xb.shape[0]
+            out.append(_pq_encode_block(jnp.pad(xb, ((0, pad), (0, 0))), codebooks)[: xb.shape[0]])
+        else:
+            out.append(_pq_encode_block(xb, codebooks))
+    return jnp.concatenate(out, axis=0)
 
 
 @jax.jit

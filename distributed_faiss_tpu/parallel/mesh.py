@@ -155,7 +155,7 @@ def _kmeans_step_jit(x, w, cent, mesh, k: int, chunk: int):
 
 
 def sharded_kmeans(mesh: Mesh, x: np.ndarray, k: int, iters: int = 10,
-                   seed: int = 0, chunk: int = 8192):
+                   seed: int = 0, chunk: int = None):
     """Lloyd k-means over a mesh-sharded training set.
 
     x is padded to a shard multiple, device_put with a row sharding, and the
@@ -169,9 +169,11 @@ def sharded_kmeans(mesh: Mesh, x: np.ndarray, k: int, iters: int = 10,
     n, d = x.shape
     if k > n:
         raise ValueError(f"k={k} > n={n}")
+    from distributed_faiss_tpu.ops.kmeans import auto_chunk
+
     S = mesh.shape[AXIS]
     per = -(-n // S)
-    chunk = min(chunk, per)
+    chunk = min(auto_chunk(k, chunk), per)
     per = -(-per // chunk) * chunk  # chunk must divide the per-shard rows
     npad = per * S
     w = np.zeros(npad, np.float32)
