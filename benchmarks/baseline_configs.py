@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Benchmark runner for the five BASELINE.md configs.
+
+    python benchmarks/baseline_configs.py [--small] [--config NAME]
+
+Measures train+add wall-clock and search QPS (with recall@10 against an
+exact fp32 ground truth) for each config BASELINE.md lists:
+
+  flat       — brute-force L2, SIFT1M-like (dim=128), single shard
+  ivf_simple — dot, dim=128, centroids=64, nprobe=12
+  knnlm      — IVF-PQ, dim=768, 4096 centroids, PQ m=64x8 (scaled in --small)
+  ivfsq      — fp16 IVF, dim=512, 1024 centroids
+  sharded    — 8-way cluster (in-process loopback servers), client-side
+               merge, nprobe sweep
+
+Prints one JSON line per config (bench.py stays the driver's single-line
+entry point; this is the full matrix).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def clustered(rng, n, d, centers):
+    assign = rng.integers(0, centers.shape[0], n)
+    return (centers[assign] + rng.standard_normal((n, d)).astype(np.float32)).astype(np.float32)
+
+
+def recall_at_k(ids, gt, k):
+    return float(np.mean([len(set(ids[i][:k]) & set(gt[i][:k])) / k for i in range(len(gt))]))
+
+
+def measure_qps(search_fn, q, k, reps=3):
+    search_fn(q[:64], k)  # warm
+    t0 = time.time()
+    for _ in range(reps):
+        search_fn(q, k)
+    return reps * q.shape[0] / (time.time() - t0)
+
+
+def run_model_config(name, index, metric, n, d, n_clusters, train_n, nprobe, rng,
+                     k=10, nq=512):
+    from distributed_faiss_tpu.models.flat import FlatIndex
+
+    centers = rng.standard_normal((n_clusters, d)).astype(np.float32) * 4.0
+    x = clustered(rng, n, d, centers)
+    q = clustered(rng, nq, d, centers)
+
+    t0 = time.time()
+    index.train(x[:train_n])
+    index.add(x)
+    build_s = time.time() - t0
+
+    exact = FlatIndex(d, metric)
+    exact.add(x)
+    _, gt = exact.search(q[:128], k)
+
+    index.set_nprobe(nprobe)
+    _, ids = index.search(q[:128], k)
+    rec = recall_at_k(ids, gt, k)
+    qps = measure_qps(lambda qq, kk: index.search(qq, kk), q, k)
+    return {
+        "config": name,
+        "n": n, "dim": d, "nprobe": nprobe,
+        "train_add_s": round(build_s, 2),
+        "recall@10": round(rec, 4),
+        "qps": round(qps, 1),
+    }
+
+
+def run_flat(rng, small):
+    from distributed_faiss_tpu.models.flat import FlatIndex
+
+    n = 100_000 if small else 1_000_000
+    d = 128
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((512, d)).astype(np.float32)
+    idx = FlatIndex(d, "l2")
+    t0 = time.time()
+    idx.add(x)
+    build_s = time.time() - t0
+    qps = measure_qps(lambda qq, kk: idx.search(qq, kk), q, 10)
+    return {"config": "flat", "n": n, "dim": d, "train_add_s": round(build_s, 2),
+            "recall@10": 1.0, "qps": round(qps, 1)}
+
+
+def run_ivf_simple(rng, small):
+    from distributed_faiss_tpu.models.ivf import IVFFlatIndex
+
+    n = 50_000 if small else 500_000
+    idx = IVFFlatIndex(128, 64, "dot", codec="f32")
+    return run_model_config("ivf_simple", idx, "dot", n, 128, 64,
+                            min(n, 10_000), 12, rng)
+
+
+def run_knnlm(rng, small):
+    from distributed_faiss_tpu.models.ivf import IVFPQIndex
+
+    # --small keeps the CPU smoke tractable (the ADC one-hot path is
+    # MXU-shaped; on CPU it is orders of magnitude slower)
+    n = 20_000 if small else 500_000
+    nlist = 128 if small else 4096
+    m = 16 if small else 64
+    d = 256 if small else 768
+    idx = IVFPQIndex(d, nlist, m=m, metric="l2", kmeans_iters=8, pq_iters=10)
+    return run_model_config("knnlm", idx, "l2", n, d, nlist,
+                            min(n, 100_000), max(nlist // 16, 8), rng,
+                            nq=128 if small else 512)
+
+
+def run_ivfsq(rng, small):
+    from distributed_faiss_tpu.models.ivf import IVFFlatIndex
+
+    n = 50_000 if small else 500_000
+    nlist = 128 if small else 1024
+    idx = IVFFlatIndex(512, nlist, "l2", codec="f16", kmeans_iters=8)
+    return run_model_config("ivfsq", idx, "l2", n, 512, nlist,
+                            min(n, 100_000), max(nlist // 16, 8), rng)
+
+
+def run_sharded(rng, small):
+    """8-shard cluster with client-side merge + nprobe sweep."""
+    import socket
+    import threading
+
+    from distributed_faiss_tpu import IndexClient, IndexCfg, IndexServer, IndexState
+    import tempfile
+
+    n = 40_000 if small else 400_000
+    d = 128
+    nlist = 64 if small else 512
+    centers = rng.standard_normal((nlist, d)).astype(np.float32) * 4.0
+    x = clustered(rng, n, d, centers)
+    q = clustered(rng, 512, d, centers)
+
+    tmp = tempfile.mkdtemp()
+    servers, ports = [], []
+    for rank in range(8):
+        s = socket.socket(); s.bind(("", 0)); port = s.getsockname()[1]; s.close()
+        srv = IndexServer(rank, tmp)
+        threading.Thread(target=srv.start_blocking, args=(port,), daemon=True).start()
+        servers.append(srv); ports.append(port)
+    disc = os.path.join(tmp, "disc.txt")
+    with open(disc, "w") as f:
+        f.write("8\n" + "".join(f"localhost,{p}\n" for p in ports))
+    client = IndexClient(disc)
+    cfg = IndexCfg(index_builder_type="ivf_simple", dim=d, metric="l2",
+                   train_num=max(2000, n // 80), centroids=max(nlist // 8, 8), nprobe=8)
+    client.create_index("bench", cfg)
+
+    t0 = time.time()
+    bs = 5000
+    for s0 in range(0, n, bs):
+        client.add_index_data("bench", x[s0:s0 + bs], list(range(s0, min(s0 + bs, n))))
+    client.sync_train("bench")
+    while client.get_state("bench") != IndexState.TRAINED:
+        time.sleep(0.2)
+    build_s = time.time() - t0
+
+    from distributed_faiss_tpu.models.flat import FlatIndex
+
+    exact = FlatIndex(d, "l2")
+    exact.add(x)
+    _, gt = exact.search(q[:128], 10)
+
+    best = None
+    for nprobe in (1, 2, 4, 8, 16, 32):
+        client.set_nprobe("bench", nprobe)
+        _, meta = client.search(q[:128], 10, "bench")
+        ids = np.array([[m if m is not None else -1 for m in row] for row in meta])
+        rec = recall_at_k(ids, gt, 10)
+        t0 = time.time()
+        client.search(q, 10, "bench")
+        qps = q.shape[0] / (time.time() - t0)
+        row = {"nprobe": nprobe, "recall@10": round(rec, 4), "qps": round(qps, 1)}
+        if best is None or rec >= 0.95:
+            best = row
+        if rec >= 0.95:
+            break
+    client.close()
+    return {"config": "sharded-8", "n": n, "dim": d, "train_add_s": round(build_s, 2),
+            **best}
+
+
+CONFIGS = {
+    "flat": run_flat,
+    "ivf_simple": run_ivf_simple,
+    "knnlm": run_knnlm,
+    "ivfsq": run_ivfsq,
+    "sharded": run_sharded,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true", help="CPU-sized corpora")
+    ap.add_argument("--config", choices=sorted(CONFIGS), default=None)
+    args = ap.parse_args()
+    rng = np.random.default_rng(0)
+    names = [args.config] if args.config else list(CONFIGS)
+    for name in names:
+        result = CONFIGS[name](rng, args.small)
+        print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -258,31 +258,58 @@ class IndexClient:
         index_id: str,
         filter_pos: int = -1,
         filter_value=None,
+        max_requery: int = 2,
     ):
         """Metadata-filtered search with over-fetch (reference
         client.py:213-263: fetch filter_top_factor*k, drop matches on
-        meta[filter_pos] == filter_value, keep first k survivors)."""
-        filter_top_factor = 3
-        actual_top_k = filter_top_factor * top_k if filter_pos >= 0 else top_k
-        scores, meta = self.search(query, actual_top_k, index_id)
-        if filter_pos < 0:
-            return scores, meta
+        meta[filter_pos] == filter_value, keep first k survivors).
 
-        new_scores, new_meta, short_ids = [], [], []
-        for i, meta_list in enumerate(meta):
-            kept_meta, kept_scores = [], []
-            for j, m in enumerate(meta_list):
-                if not m:
-                    continue
-                if len(m) > filter_pos and m[filter_pos] != filter_value:
-                    kept_meta.append(m)
-                    kept_scores.append(scores[i, j])
-                if len(kept_meta) >= top_k:
-                    break
-            if len(kept_meta) < top_k:
-                short_ids.append(i)
-            new_meta.append(kept_meta)
-            new_scores.append(np.asarray(kept_scores).reshape(-1, 1))
+        Under-filled queries are re-searched with a growing factor up to
+        ``max_requery`` times — the reference leaves this as a TODO and
+        returns short rows; we implement it (set max_requery=0 for exact
+        reference behavior)."""
+        filter_top_factor = 3
+        if filter_pos < 0:
+            return self.search(query, top_k, index_id)
+
+        def filter_rows(scores, meta):
+            out_scores, out_meta, short = [], [], []
+            for i, meta_list in enumerate(meta):
+                kept_meta, kept_scores = [], []
+                for j, m in enumerate(meta_list):
+                    if not m:
+                        continue
+                    if len(m) > filter_pos and m[filter_pos] != filter_value:
+                        kept_meta.append(m)
+                        kept_scores.append(scores[i, j])
+                    if len(kept_meta) >= top_k:
+                        break
+                if len(kept_meta) < top_k:
+                    short.append(i)
+                out_meta.append(kept_meta)
+                out_scores.append(np.asarray(kept_scores).reshape(-1, 1))
+            return out_scores, out_meta, short
+
+        factor = filter_top_factor
+        scores, meta = self.search(query, factor * top_k, index_id)
+        new_scores, new_meta, short_ids = filter_rows(scores, meta)
+
+        ntotal = None
+        for _ in range(max_requery):
+            if not short_ids:
+                break
+            if ntotal is None:
+                ntotal = self.get_ntotal(index_id)
+            if factor * top_k >= ntotal:
+                break  # already saw the whole index
+            factor *= filter_top_factor
+            requery = np.asarray(query)[short_ids]
+            s2, m2 = self.search(requery, min(factor * top_k, ntotal), index_id)
+            f_scores, f_meta, still_short = filter_rows(s2, m2)
+            for pos, qi in enumerate(short_ids):
+                new_scores[qi] = f_scores[pos]
+                new_meta[qi] = f_meta[pos]
+            short_ids = [short_ids[pos] for pos in still_short]
         if short_ids:
             logger.info(
                 "%d samples returned fewer than %d results after filtering",

@@ -213,6 +213,10 @@ class IndexServer:
             pass
         except OSError as e:
             logger.info("socket error from %s: %s", addr, e)
+        except Exception as e:
+            # malformed frame / undecodable payload: drop this connection
+            # only — the server keeps serving everyone else
+            logger.warning("dropping connection from %s: %s", addr, e)
         finally:
             try:
                 conn.close()

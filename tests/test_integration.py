@@ -259,6 +259,30 @@ def test_search_with_filter(cluster, rng, request):
     client.close()
 
 
+def test_search_with_filter_requery(cluster, rng, request):
+    """Heavily-filtered corpus: the re-query loop (our fix of the
+    reference's TODO, client.py:254-257) must fill rows the first
+    over-fetch couldn't."""
+    index_id = request.node.name
+    client = IndexClient(cluster["multi"])
+    client.create_index(index_id, flat_cfg(train_num=20))
+    x = rng.standard_normal((400, 16)).astype(np.float32)
+    # 95% of entries filtered out: 3x over-fetch of k=5 won't find 5 rares
+    meta = [("rare" if i % 20 == 0 else "common", i) for i in range(400)]
+    fill(client, index_id, x, meta)
+    client.sync_train(index_id)
+    assert wait_trained(client, index_id)
+    _, no_requery = client.search_with_filter(
+        x[:4], 5, index_id, filter_pos=0, filter_value="common", max_requery=0)
+    _, with_requery = client.search_with_filter(
+        x[:4], 5, index_id, filter_pos=0, filter_value="common", max_requery=3)
+    assert all(len(row) == 5 for row in with_requery)
+    assert all(e[0] == "rare" for row in with_requery for e in row)
+    # reference behavior returns short rows here
+    assert any(len(row) < 5 for row in no_requery)
+    client.close()
+
+
 def test_get_ids_and_embeddings(cluster, rng, request):
     index_id = request.node.name
     client = IndexClient(cluster["multi"])

@@ -140,6 +140,36 @@ def test_remote_exception(echo_endpoint):
     c.close()
 
 
+def test_malformed_frame_kills_only_that_connection(tmp_path):
+    """Garbage bytes on the wire must drop that connection, not the server."""
+    from distributed_faiss_tpu.parallel.server import IndexServer
+
+    port = free_port()
+    srv = IndexServer(0, str(tmp_path))
+    threading.Thread(target=srv.start_blocking, args=(port,), daemon=True).start()
+    deadline = time.time() + 10
+    probe = None
+    while time.time() < deadline:
+        try:
+            probe = socket.create_connection(("localhost", port), timeout=1)
+            break
+        except OSError:
+            time.sleep(0.05)
+    assert probe is not None, "server never started listening"
+    # send garbage on a raw socket
+    probe.sendall(b"\x00" * 64)
+    probe.close()
+    bad = socket.create_connection(("localhost", port))
+    bad.sendall(b"NOPE" + b"\xff" * 32)
+    time.sleep(0.2)
+    bad.close()
+    # server still serves well-formed clients
+    c = rpc.Client(0, "localhost", port)
+    assert c.get_rank() == 0
+    c.close()
+    srv.stop()
+
+
 def test_many_threaded_clients(echo_endpoint):
     host, port, srv = echo_endpoint
     errors = []
