@@ -24,6 +24,7 @@ Builders (same names as the reference):
               multi-chip mesh sharding via parallel/mesh.py.
 """
 
+import logging
 from typing import Optional
 
 from distributed_faiss_tpu.models.flat import FlatIndex
@@ -53,11 +54,26 @@ def _build_ivf_simple(cfg: IndexCfg) -> IVFFlatIndex:
                         kmeans_iters=_kmeans_iters(cfg))
 
 
-def _build_knnlm(cfg: IndexCfg) -> IVFPQIndex:
+def _build_knnlm(cfg: IndexCfg):
     m = int(cfg.extra.get("code_size", 64))
     nbits = int(cfg.extra.get("nbits", 8))
+    if cfg.extra.get("shard_lists"):
+        from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex, make_mesh
+
+        if cfg.extra.get("pallas_adc"):
+            logging.getLogger().warning(
+                "pallas_adc is not yet supported on the sharded IVF-PQ path; "
+                "using the XLA one-hot ADC"
+            )
+        n_dev = cfg.extra.get("mesh_devices")
+        return ShardedIVFPQIndex(
+            cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
+            mesh=make_mesh(int(n_dev)) if n_dev else None,
+            kmeans_iters=_kmeans_iters(cfg),
+        )
     return IVFPQIndex(cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
-                      kmeans_iters=_kmeans_iters(cfg))
+                      kmeans_iters=_kmeans_iters(cfg),
+                      use_pallas=bool(cfg.extra.get("pallas_adc", False)))
 
 
 def _build_ivfsq(cfg: IndexCfg) -> IVFFlatIndex:
@@ -223,12 +239,19 @@ def _sharded_ivf_cls():
     return ShardedIVFFlatIndex
 
 
+def _sharded_ivf_pq_cls():
+    from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex
+
+    return ShardedIVFPQIndex
+
+
 _STATE_KINDS = {
     "flat": lambda: FlatIndex,
     "ivf_flat": lambda: IVFFlatIndex,
     "ivf_pq": lambda: IVFPQIndex,
     "sharded_flat": _sharded_flat_cls,
     "sharded_ivf_flat": _sharded_ivf_cls,
+    "sharded_ivf_pq": _sharded_ivf_pq_cls,
     "hnswsq": _hnswsq_cls,
 }
 

@@ -108,9 +108,10 @@ def _ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
     return vals, ids
 
 
-@functools.partial(jax.jit, static_argnames=("k", "nprobe", "g", "metric"))
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "g", "metric", "use_pallas"))
 def _ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_sizes, q,
-                   k: int, nprobe: int, g: int, metric: str):
+                   k: int, nprobe: int, g: int, metric: str,
+                   use_pallas: bool = False):
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
     _, probes = jax.lax.top_k(coarse, nprobe)
@@ -137,10 +138,18 @@ def _ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_sizes, q,
             lut = lut.reshape(nq, g, m, ksub)
         else:
             lut = jnp.broadcast_to(shared_lut[:, None], (nq, g, m, ksub))
-        iota = jnp.arange(ksub, dtype=jnp.int32)
-        onehot = (codes[..., None].astype(jnp.int32) == iota).astype(jnp.float32)
-        s = jnp.einsum("qgmj,qgcmj->qgc", lut, onehot, precision=_HIGHEST,
-                       preferred_element_type=jnp.float32)
+        if use_pallas:
+            # fused VMEM kernel: per-(query, probe) LUT vs its code tile
+            from distributed_faiss_tpu.ops import adc_pallas
+
+            s = adc_pallas.adc_scan_auto(
+                lut.reshape(nq * g, m, ksub), codes.reshape(nq * g, cap, m)
+            ).reshape(nq, g, cap)
+        else:
+            iota = jnp.arange(ksub, dtype=jnp.int32)
+            onehot = (codes[..., None].astype(jnp.int32) == iota).astype(jnp.float32)
+            s = jnp.einsum("qgmj,qgcmj->qgc", lut, onehot, precision=_HIGHEST,
+                           preferred_element_type=jnp.float32)
         valid = (jnp.arange(cap)[None, None, :] < sizes[:, :, None]) & (ids >= 0)
         s = jnp.where(valid, s, distance.NEG_INF)
         return _merge_group(carry, s.reshape(nq, g * cap), ids.reshape(nq, g * cap), k), None
@@ -253,12 +262,15 @@ class IVFFlatIndex(_IVFBase):
         self.codec = codec
         self.sq_params = None
 
+    def _make_lists(self):
+        return base.PaddedLists(self.nlist, (self.dim,), self._DTYPES[self.codec])
+
     def train(self, x: np.ndarray) -> None:
         x = np.asarray(x, np.float32)
         self._train_centroids(x)
         if self.codec == "sq8":
             self.sq_params = sq.sq8_train(x)
-        self.lists = base.PaddedLists(self.nlist, (self.dim,), self._DTYPES[self.codec])
+        self.lists = self._make_lists()
 
     def _encode(self, x: np.ndarray, assign: np.ndarray) -> np.ndarray:
         if self.codec == "sq8":
@@ -334,7 +346,8 @@ class IVFPQIndex(_IVFBase):
     """
 
     def __init__(self, dim: int, nlist: int, m: int = 64, nbits: int = 8,
-                 metric: str = "l2", kmeans_iters: int = 10, pq_iters: int = 15):
+                 metric: str = "l2", kmeans_iters: int = 10, pq_iters: int = 15,
+                 use_pallas: bool = False):
         super().__init__(dim, nlist, metric, kmeans_iters)
         if dim % m != 0:
             raise ValueError(f"dim {dim} not divisible by PQ m={m}")
@@ -343,11 +356,15 @@ class IVFPQIndex(_IVFBase):
         self.m = m
         self.nbits = nbits
         self.pq_iters = pq_iters
+        self.use_pallas = use_pallas  # fused ADC kernel instead of XLA one-hot
         self.codebooks = None  # (m, 256, dsub)
 
     @property
     def is_trained(self) -> bool:
         return self.centroids is not None and self.codebooks is not None
+
+    def _make_lists(self):
+        return base.PaddedLists(self.nlist, (self.m,), np.uint8)
 
     def train(self, x: np.ndarray) -> None:
         x = np.asarray(x, np.float32)
@@ -358,7 +375,7 @@ class IVFPQIndex(_IVFBase):
         else:
             train_vecs = x
         self.codebooks = pq.pq_train(train_vecs, self.m, iters=self.pq_iters)
-        self.lists = base.PaddedLists(self.nlist, (self.m,), np.uint8)
+        self.lists = self._make_lists()
 
     def _encode(self, x: np.ndarray, assign: np.ndarray) -> np.ndarray:
         if self.metric == "l2":
@@ -378,6 +395,7 @@ class IVFPQIndex(_IVFBase):
             lambda b: _ivf_pq_search(
                 self.centroids, self.codebooks, self.lists.data, self.lists.ids,
                 self.lists.sizes, b, k, nprobe, g, self.metric,
+                use_pallas=self.use_pallas,
             ),
         )
 

@@ -40,7 +40,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_fn
 
 from distributed_faiss_tpu.models import base
-from distributed_faiss_tpu.models.ivf import IVFFlatIndex, probe_group_size
+from distributed_faiss_tpu.models.ivf import IVFFlatIndex, IVFPQIndex, probe_group_size
 from distributed_faiss_tpu.ops import distance
 
 _HIGHEST = jax.lax.Precision.HIGHEST
@@ -544,10 +544,8 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
     def _train_centroids(self, x: np.ndarray):
         self.centroids = sharded_kmeans(self.mesh, x, self.nlist, iters=self.kmeans_iters)
 
-    def train(self, x: np.ndarray) -> None:
-        x = np.asarray(x, np.float32)
-        self._train_centroids(x)
-        self.lists = ShardedPaddedLists(self.nlist, (self.dim,), np.float32, self.mesh)
+    def _make_lists(self):
+        return ShardedPaddedLists(self.nlist, (self.dim,), np.float32, self.mesh)
 
     def search(self, q: np.ndarray, k: int):
         if self._n == 0:
@@ -575,6 +573,137 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
             return idx
         idx.centroids = jnp.asarray(state["centroids"])
         idx.lists = ShardedPaddedLists(idx.nlist, (idx.dim,), np.float32, idx.mesh)
+        rows, assign = state["rows"], state["assign"]
+        if rows.shape[0]:
+            idx.lists.append(assign, rows, np.arange(rows.shape[0], dtype=np.int64))
+            idx._host_rows = [rows]
+            idx._host_assign = [assign]
+            idx._n = rows.shape[0]
+        return idx
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric"))
+def _sharded_ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_sizes,
+                           q, mesh, k: int, nprobe: int, g: int, metric: str):
+    """IVF-PQ with mesh-sharded code lists: per-chip ADC over owned probes
+    (residual LUTs for l2 computed locally against replicated centroids),
+    ICI all_gather merge. Same ownership masking trade-off as
+    _sharded_ivf_flat_search."""
+    q = q.astype(jnp.float32)
+    coarse = distance.pairwise_scores(q, centroids, metric)
+    _, probes = jax.lax.top_k(coarse, nprobe)
+    nq = q.shape[0]
+    cap = list_codes.shape[1]
+    m, ksub, _ = codebooks.shape
+    S = mesh.shape[AXIS]
+    groups = probes.reshape(nq, nprobe // g, g).transpose(1, 0, 2)
+
+    from distributed_faiss_tpu.ops import pq as pqops
+
+    if metric != "l2":
+        shared_lut = pqops.adc_lut(q, codebooks, metric=metric)
+
+    def local(q, groups, codes_local, ids_local, sizes_local):
+        ax = jax.lax.axis_index(AXIS).astype(jnp.int32)
+        # never-taken select: vma-consistent scan carry (see flat variant)
+        anchor = jnp.where(jnp.zeros((), bool),
+                           codes_local.reshape(-1)[0].astype(jnp.float32), 0.0)
+        init = (
+            jnp.full((nq, k), distance.NEG_INF, jnp.float32) + anchor,
+            jnp.full((nq, k), -1, jnp.int32) + anchor.astype(jnp.int32),
+        )
+
+        def body(carry, li):  # (nq, g) global list ids
+            mine = (li % S) == ax
+            slot = jnp.where(mine, li // S, 0)
+            codes = codes_local[slot]  # (nq, g, cap, m)
+            ids = ids_local[slot]
+            sizes = sizes_local[slot]
+            if metric == "l2":
+                r = q[:, None, :] - centroids[li]
+                lut = pqops.adc_lut(r.reshape(nq * g, -1), codebooks, metric="l2")
+                lut = lut.reshape(nq, g, m, ksub)
+            else:
+                lut = jnp.broadcast_to(shared_lut[:, None], (nq, g, m, ksub))
+            iota = jnp.arange(ksub, dtype=jnp.int32)
+            onehot = (codes[..., None].astype(jnp.int32) == iota).astype(jnp.float32)
+            s = jnp.einsum("qgmj,qgcmj->qgc", lut, onehot, precision=_HIGHEST,
+                           preferred_element_type=jnp.float32)
+            valid = (jnp.arange(cap)[None, None, :] < sizes[:, :, None])
+            valid = valid & (ids >= 0) & mine[:, :, None]
+            s = jnp.where(valid, s, distance.NEG_INF)
+            ids = jnp.where(valid, ids, -1)
+            cv, cp = jax.lax.top_k(s.reshape(nq, g * cap), min(k, g * cap))
+            cids = jnp.take_along_axis(ids.reshape(nq, g * cap), cp, axis=1)
+            return distance.merge_topk(carry[0], carry[1], cv, cids, k), None
+
+        (vals, ids), _ = jax.lax.scan(body, init, groups)
+        av = jax.lax.all_gather(vals, AXIS)
+        ai = jax.lax.all_gather(ids, AXIS)
+        fv = jnp.transpose(av, (1, 0, 2)).reshape(nq, -1)
+        fi = jnp.transpose(ai, (1, 0, 2)).reshape(nq, -1)
+        best, pos = jax.lax.top_k(fv, k)
+        return best, jnp.take_along_axis(fi, pos, axis=1)
+
+    fn = _shard_map_fn(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return fn(q, groups, list_codes, list_ids, list_sizes)
+
+
+class ShardedIVFPQIndex(IVFPQIndex):
+    """IVF-PQ with mesh-sharded inverted code lists: coarse k-means trains
+    with psum, PQ codebooks replicate, code storage partitions across chip
+    HBMs (the BASELINE.json north-star config — sharded IVF-PQ — inside one
+    server rank). Enable via the knnlm builder's extra
+    {'shard_lists': True}."""
+
+    def __init__(self, dim: int, nlist: int, m: int = 64, nbits: int = 8,
+                 metric: str = "l2", mesh: Optional[Mesh] = None,
+                 kmeans_iters: int = 10, pq_iters: int = 15):
+        super().__init__(dim, nlist, m=m, nbits=nbits, metric=metric,
+                         kmeans_iters=kmeans_iters, pq_iters=pq_iters)
+        self.mesh = mesh or make_mesh()
+
+    def _train_centroids(self, x: np.ndarray):
+        self.centroids = sharded_kmeans(self.mesh, x, self.nlist, iters=self.kmeans_iters)
+
+    def _make_lists(self):
+        return ShardedPaddedLists(self.nlist, (self.m,), np.uint8, self.mesh)
+
+    def search(self, q: np.ndarray, k: int):
+        if self._n == 0:
+            return self._empty_results(q.shape[0], k)
+        nprobe = min(self.nprobe, self.nlist)
+        per_probe = 256 * self.lists.cap * (self.m + 8) + 256 * self.m * 256 * 4
+        g = probe_group_size(nprobe, per_probe)
+        return self._search_blocks(
+            q, k,
+            lambda b: _sharded_ivf_pq_search(
+                self.centroids, self.codebooks, self.lists.data, self.lists.ids,
+                self.lists.sizes, b, self.mesh, k, nprobe, g, self.metric,
+            ),
+        )
+
+    def state_dict(self):
+        state = super().state_dict()
+        state["kind"] = "sharded_ivf_pq"
+        return state
+
+    @classmethod
+    def from_state_dict(cls, state):
+        idx = cls(int(state["dim"]), int(state["nlist"]), m=int(state["m"]),
+                  nbits=int(state["nbits"]), metric=str(state["metric"]))
+        idx.nprobe = int(state["nprobe"])
+        if not bool(state["trained"]):
+            return idx
+        idx.centroids = jnp.asarray(state["centroids"])
+        idx.codebooks = jnp.asarray(state["codebooks"])
+        idx.lists = ShardedPaddedLists(idx.nlist, (idx.m,), np.uint8, idx.mesh)
         rows, assign = state["rows"], state["assign"]
         if rows.shape[0]:
             idx.lists.append(assign, rows, np.arange(rows.shape[0], dtype=np.int64))

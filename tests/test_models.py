@@ -139,6 +139,26 @@ def test_ivf_pq_recall(rng, metric):
     assert (I >= 0).all()
 
 
+@pytest.mark.parametrize("metric", ["dot", "l2"])
+def test_ivf_pq_pallas_path_matches_xla(rng, metric):
+    """use_pallas=True (interpreter on CPU — same kernel body as TPU) must
+    produce identical rankings to the XLA one-hot path."""
+    d, m = 32, 8
+    x = rng.standard_normal((1200, d)).astype(np.float32)
+    q = rng.standard_normal((5, d)).astype(np.float32)
+    a = IVFPQIndex(d, 4, m=m, metric=metric)
+    a.train(x[:600]); a.add(x); a.set_nprobe(4)
+    b = IVFPQIndex(d, 4, m=m, metric=metric, use_pallas=True)
+    b.centroids, b.codebooks = a.centroids, a.codebooks
+    b.lists = a.lists
+    b._host_rows, b._host_assign, b._n = a._host_rows, a._host_assign, a._n
+    b.set_nprobe(4)
+    Da, Ia = a.search(q, 8)
+    Db, Ib = b.search(q, 8)
+    np.testing.assert_array_equal(Ia, Ib)
+    np.testing.assert_allclose(Da, Db, rtol=1e-4, atol=1e-4)
+
+
 def test_ivf_pq_reconstruct_matches_adc(rng):
     """Search scores must equal exact distance to the reconstructed vectors."""
     d, m = 16, 4

@@ -117,6 +117,54 @@ def test_sharded_ivf_state_round_trip(rng, tmp_path):
     np.testing.assert_allclose(rec, x[I1[0][:3]], rtol=1e-5)
 
 
+@pytest.mark.parametrize("metric", ["dot", "l2"])
+def test_sharded_ivf_pq_matches_single_device(rng, metric):
+    """Sharded IVF-PQ == single-device IVF-PQ when sharing trained state."""
+    from distributed_faiss_tpu.models.ivf import IVFPQIndex
+    from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex
+
+    d, m = 32, 8
+    x = rng.standard_normal((2000, d)).astype(np.float32)
+    q = rng.standard_normal((6, d)).astype(np.float32)
+    single = IVFPQIndex(d, 8, m=m, metric=metric)
+    single.train(x)
+    single.add(x)
+    single.set_nprobe(8)
+    sharded = ShardedIVFPQIndex(d, 8, m=m, metric=metric)
+    # share the trained coarse+codebooks so rankings must be identical
+    sharded.centroids, sharded.codebooks = single.centroids, single.codebooks
+    from distributed_faiss_tpu.parallel.mesh import ShardedPaddedLists
+    sharded.lists = ShardedPaddedLists(8, (m,), np.uint8, sharded.mesh)
+    sharded.add(x)
+    sharded.set_nprobe(8)
+    Du, Iu = single.search(q, 10)
+    Ds, Is = sharded.search(q, 10)
+    np.testing.assert_array_equal(Is, Iu)
+    np.testing.assert_allclose(Ds, Du, rtol=1e-3, atol=1e-3)
+
+
+def test_sharded_ivf_pq_lifecycle(rng, tmp_path):
+    from distributed_faiss_tpu.models.factory import build_index, index_from_state_dict
+    from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex
+    from distributed_faiss_tpu.utils.config import IndexCfg
+    from distributed_faiss_tpu.utils.serialization import load_state, save_state
+
+    cfg = IndexCfg(index_builder_type="knnlm", dim=16, metric="l2",
+                   centroids=4, nprobe=4, code_size=4, shard_lists=True)
+    idx = build_index(cfg)
+    assert isinstance(idx, ShardedIVFPQIndex)
+    x = rng.standard_normal((800, 16)).astype(np.float32)
+    idx.train(x)
+    idx.add(x)
+    D0, I0 = idx.search(x[:4], 5)
+    assert (I0[:, 0] == np.arange(4)).all()
+    p = str(tmp_path / "spq.npz")
+    save_state(p, idx.state_dict())
+    idx2 = index_from_state_dict(load_state(p))
+    D1, I1 = idx2.search(x[:4], 5)
+    np.testing.assert_array_equal(I0, I1)
+
+
 def test_ivf_tpu_shard_lists_builder(rng):
     from distributed_faiss_tpu.models.factory import build_index
     from distributed_faiss_tpu.utils.config import IndexCfg
