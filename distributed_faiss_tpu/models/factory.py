@@ -60,11 +60,12 @@ def _build_knnlm(cfg: IndexCfg):
     if cfg.extra.get("shard_lists"):
         from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex, make_mesh
 
-        if cfg.extra.get("pallas_adc"):
-            logging.getLogger().warning(
-                "pallas_adc is not yet supported on the sharded IVF-PQ path; "
-                "using the XLA one-hot ADC"
-            )
+        for unsupported in ("pallas_adc", "refine_k_factor"):
+            if cfg.extra.get(unsupported):
+                logging.getLogger().warning(
+                    "%s is not yet supported on the sharded IVF-PQ path; ignored",
+                    unsupported,
+                )
         n_dev = cfg.extra.get("mesh_devices")
         return ShardedIVFPQIndex(
             cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
@@ -73,7 +74,8 @@ def _build_knnlm(cfg: IndexCfg):
         )
     return IVFPQIndex(cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
                       kmeans_iters=_kmeans_iters(cfg),
-                      use_pallas=bool(cfg.extra.get("pallas_adc", False)))
+                      use_pallas=bool(cfg.extra.get("pallas_adc", False)),
+                      refine_k_factor=int(cfg.extra.get("refine_k_factor", 0)))
 
 
 def _build_ivfsq(cfg: IndexCfg) -> IVFFlatIndex:

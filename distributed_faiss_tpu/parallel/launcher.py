@@ -83,8 +83,9 @@ def run_server(rank: int, port: int, discovery_path: str, storage_dir: str,
     """Register in the discovery file, then serve forever (one rank)."""
     import socket as socketmod
 
-    from distributed_faiss_tpu.parallel.server import IndexServer
+    from distributed_faiss_tpu.parallel.server import IndexServer, setup_server_logging
 
+    setup_server_logging()
     host = host or socketmod.gethostname()
     append_discovery_entry(discovery_path, host, port)
     server = IndexServer(rank, storage_dir)

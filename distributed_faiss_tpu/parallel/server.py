@@ -39,6 +39,17 @@ from distributed_faiss_tpu.utils.tracing import LatencyStats
 logger = logging.getLogger()
 
 
+def setup_server_logging(level=logging.INFO) -> None:
+    """Thread-aware root-logger format (parity with the reference's server
+    bootstrap, server.py:28-35: '[thread] time [level] ...' — the ops story
+    is verbose logs, README.md:59-61)."""
+    logging.basicConfig(
+        level=level,
+        format="[%(threadName)s] %(asctime)s [%(levelname)s] %(message)s",
+        force=True,
+    )
+
+
 class IndexServer:
     def __init__(self, rank: int, index_storage_dir: str):
         self.indexes: Dict[str, Index] = {}
@@ -303,7 +314,7 @@ def main(argv=None):
     parser.add_argument("--ipv6", action="store_true")
     parser.add_argument("--load-index", action="store_true")
     args = parser.parse_args(argv)
-    logging.basicConfig(level=logging.INFO)
+    setup_server_logging()
     server = IndexServer(args.rank, args.storage_dir)
     server.start_blocking(args.port, v6=args.ipv6, load_index=args.load_index)
 
