@@ -246,6 +246,13 @@ class Index:
         with self.index_lock:
             if self.state == IndexState.ADD:  # don't stomp a concurrent drop
                 self.state = IndexState.TRAINED
+        # rows appended between the empty-buffer check and the state flip
+        # would otherwise be stranded until the NEXT add_batch (the reference
+        # shares this race): re-trigger the drain if the buffer refilled
+        with self.buffer_lock:
+            refilled = self.total_data > 0
+        if refilled:
+            self.add_buffer_to_index()
 
     # ------------------------------------------------------------------ query
 

@@ -385,6 +385,8 @@ class IVFPQIndex(_IVFBase):
         # refine_k_factor > 0: keep fp16 raw rows in HBM and exactly rescore
         # the top k*refine_k_factor ADC candidates (FAISS IndexRefine-style;
         # what lifts PQ configs past recall 0.95)
+        if int(refine_k_factor) != refine_k_factor or int(refine_k_factor) < 0:
+            raise ValueError(f"refine_k_factor must be a non-negative int, got {refine_k_factor!r}")
         self.refine_k_factor = int(refine_k_factor)
         self.refine_store = (
             base.DeviceVectorStore((dim,), jnp.float16) if self.refine_k_factor else None

@@ -170,6 +170,55 @@ def test_malformed_frame_kills_only_that_connection(tmp_path):
     srv.stop()
 
 
+@pytest.mark.slow
+def test_multiprocess_clients(tmp_path):
+    """10 client *processes* against one server (reference tests/test_rpc.py
+    runs a multiprocessing.Pool of 10; we use subprocesses for isolation)."""
+    import os
+    import subprocess
+    import sys
+
+    from distributed_faiss_tpu.parallel.server import IndexServer
+
+    port = free_port()
+    srv = IndexServer(0, str(tmp_path))
+    threading.Thread(target=srv.start_blocking, args=(port,), daemon=True).start()
+
+    client_code = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from distributed_faiss_tpu.parallel.rpc import Client
+from distributed_faiss_tpu.utils.config import IndexCfg
+port, wid = int(sys.argv[1]), int(sys.argv[2])
+c = Client(wid, "localhost", port)
+c.create_index("mp", IndexCfg(index_builder_type="flat", dim=8, metric="l2", train_num=1))
+x = np.full((5, 8), float(wid), np.float32)
+c.add_index_data("mp", x, [(wid, j) for j in range(5)])
+assert c.get_rank() == 0
+c.close()
+print("ok", wid)
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen([sys.executable, "-c", client_code, str(port), str(i)],
+                         env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo},
+                         cwd=repo, stdout=subprocess.PIPE, text=True)
+        for i in range(10)
+    ]
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0 and out.startswith("ok")
+    # all 50 rows landed
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if srv.get_ntotal("mp") == 50:
+            break
+        time.sleep(0.1)
+    assert srv.get_ntotal("mp") == 50
+    srv.stop()
+
+
 def test_many_threaded_clients(echo_endpoint):
     host, port, srv = echo_endpoint
     errors = []

@@ -95,6 +95,34 @@ def test_concurrent_add_search_save(rng, tmp_path):
     assert D.shape == (2, 5)
 
 
+def test_no_stranded_rows_after_add_race(rng):
+    """Rows appended in the drain-exit window must still reach the index
+    without further add_batch calls (the reference strands them until the
+    next add; our drain re-trigger fixes it)."""
+    for trial in range(3):
+        idx = Index(IndexCfg(index_builder_type="flat", dim=8, metric="l2",
+                             train_num=1, buffer_bsz=256))
+
+        def writer(seed):
+            r = np.random.default_rng(seed)
+            for _ in range(20):
+                idx.add_batch(r.standard_normal((37, 8)).astype(np.float32), None)
+
+        threads = [threading.Thread(target=writer, args=(trial * 10 + i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = 4 * 20 * 37
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            buf, n = idx.get_idx_data_num()
+            if buf == 0 and n == total and idx.get_state() == IndexState.TRAINED:
+                break
+            time.sleep(0.05)
+        assert idx.get_idx_data_num() == (0, total)
+
+
 def test_concurrent_drop_during_add(rng):
     """drop_index racing the async add worker must not wedge the state."""
     for trial in range(3):
