@@ -45,7 +45,26 @@ def _kmeans_iters(cfg: IndexCfg) -> int:
     return int(cfg.extra.get("kmeans_iters", 10))
 
 
-def _build_flat(cfg: IndexCfg) -> FlatIndex:
+def _mesh(cfg: IndexCfg):
+    """Resolve the optional device mesh from cfg.extra['mesh_devices']
+    (lazy import: only mesh-backed builders pay for jax.sharding)."""
+    from distributed_faiss_tpu.parallel.mesh import make_mesh
+
+    n_dev = cfg.extra.get("mesh_devices")
+    return make_mesh(int(n_dev)) if n_dev else None
+
+
+def _build_flat(cfg: IndexCfg):
+    if cfg.extra.get("mesh_shards"):
+        # exact search with the corpus sharded across the chip mesh
+        from distributed_faiss_tpu.parallel.mesh import ShardedFlatIndex
+
+        return ShardedFlatIndex(cfg.dim, cfg.get_metric(), mesh=_mesh(cfg))
+    if cfg.extra.get("mesh_devices"):
+        logging.getLogger().warning(
+            "mesh_devices is set but mesh_shards is not: building a "
+            "single-device flat index (set mesh_shards=True to shard)"
+        )
     return FlatIndex(cfg.dim, cfg.get_metric())
 
 
@@ -58,7 +77,7 @@ def _build_knnlm(cfg: IndexCfg):
     m = int(cfg.extra.get("code_size", 64))
     nbits = int(cfg.extra.get("nbits", 8))
     if cfg.extra.get("shard_lists"):
-        from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex, make_mesh
+        from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex
 
         for unsupported in ("pallas_adc", "refine_k_factor"):
             if cfg.extra.get(unsupported):
@@ -66,11 +85,9 @@ def _build_knnlm(cfg: IndexCfg):
                     "%s is not yet supported on the sharded IVF-PQ path; ignored",
                     unsupported,
                 )
-        n_dev = cfg.extra.get("mesh_devices")
         return ShardedIVFPQIndex(
             cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
-            mesh=make_mesh(int(n_dev)) if n_dev else None,
-            kmeans_iters=_kmeans_iters(cfg),
+            mesh=_mesh(cfg), kmeans_iters=_kmeans_iters(cfg),
         )
     return IVFPQIndex(cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
                       kmeans_iters=_kmeans_iters(cfg),
@@ -101,13 +118,9 @@ def _build_hnswsq(cfg: IndexCfg):
 
 
 def _build_ivf_tpu(cfg: IndexCfg):
-    # lazy import: mesh pulls in jax.sharding machinery only when used
-    from distributed_faiss_tpu.parallel.mesh import (
-        IvfTpuIndex, ShardedIVFFlatIndex, make_mesh,
-    )
+    from distributed_faiss_tpu.parallel.mesh import IvfTpuIndex, ShardedIVFFlatIndex
 
-    n_dev = cfg.extra.get("mesh_devices")
-    mesh = make_mesh(int(n_dev)) if n_dev else None
+    mesh = _mesh(cfg)
     if cfg.extra.get("shard_lists"):
         # full multi-chip path: inverted lists partitioned across the mesh
         return ShardedIVFFlatIndex(cfg.dim, _centroids(cfg), cfg.get_metric(),

@@ -103,6 +103,21 @@ def test_sharded_flat_state_round_trip(rng, tmp_path):
     np.testing.assert_array_equal(I0, I1)
 
 
+def test_flat_mesh_builder(rng):
+    from distributed_faiss_tpu.models.factory import build_index
+    from distributed_faiss_tpu.utils.config import IndexCfg
+
+    cfg = IndexCfg(index_builder_type="flat", dim=8, metric="l2",
+                   mesh_shards=True, mesh_devices=4)
+    idx = build_index(cfg)
+    assert isinstance(idx, meshmod.ShardedFlatIndex)
+    assert idx.nshards == 4
+    x = rng.standard_normal((200, 8)).astype(np.float32)
+    idx.add(x)
+    D, I = idx.search(x[:3], 4)
+    assert (I[:, 0] == np.arange(3)).all()
+
+
 def test_ivf_tpu_builder(rng):
     from distributed_faiss_tpu.models.factory import build_index
     from distributed_faiss_tpu.utils.config import IndexCfg
