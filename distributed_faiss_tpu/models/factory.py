@@ -79,7 +79,7 @@ def _build_knnlm(cfg: IndexCfg):
     if cfg.extra.get("shard_lists"):
         from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex
 
-        for unsupported in ("pallas_adc", "refine_k_factor"):
+        for unsupported in ("pallas_adc", "refine_k_factor", "probe_routing"):
             if cfg.extra.get(unsupported):
                 logging.getLogger().warning(
                     "%s is not yet supported on the sharded IVF-PQ path; ignored",
@@ -124,7 +124,12 @@ def _build_ivf_tpu(cfg: IndexCfg):
     if cfg.extra.get("shard_lists"):
         # full multi-chip path: inverted lists partitioned across the mesh
         return ShardedIVFFlatIndex(cfg.dim, _centroids(cfg), cfg.get_metric(),
-                                   mesh=mesh, kmeans_iters=_kmeans_iters(cfg))
+                                   mesh=mesh, kmeans_iters=_kmeans_iters(cfg),
+                                   probe_routing=bool(cfg.extra.get("probe_routing")))
+    if cfg.extra.get("probe_routing"):
+        logging.getLogger().warning(
+            "probe_routing requires shard_lists=True on the ivf_tpu builder; ignored"
+        )
     return IvfTpuIndex(cfg.dim, _centroids(cfg), cfg.get_metric(), "f32",
                        mesh=mesh, kmeans_iters=_kmeans_iters(cfg))
 

@@ -118,6 +118,57 @@ def test_sharded_ivf_state_round_trip(rng, tmp_path):
 
 
 @pytest.mark.parametrize("metric", ["dot", "l2"])
+def test_routed_full_probe_exact(rng, metric):
+    """Probe routing at nprobe == nlist: exactly brute force (uniform
+    ownership — the bucket never drops)."""
+    x = rng.standard_normal((1500, 16)).astype(np.float32)
+    q = rng.standard_normal((6, 16)).astype(np.float32)
+    idx = ShardedIVFFlatIndex(16, 8, metric, probe_routing=True)
+    idx.train(x[:800])
+    idx.add(x)
+    idx.set_nprobe(8)
+    D, I = idx.search(q, 10)
+    wi = brute_ids(q, x, 10, metric)
+    np.testing.assert_array_equal(I, wi)
+
+
+def test_routed_matches_masked(rng):
+    """Routed and masked sharded search agree given identical trained state
+    (same probes -> same candidate set)."""
+    x = rng.standard_normal((3000, 16)).astype(np.float32)
+    q = rng.standard_normal((12, 16)).astype(np.float32)
+    masked = ShardedIVFFlatIndex(16, 16, "l2")
+    masked.train(x)
+    masked.add(x)
+    masked.set_nprobe(6)
+    routed = ShardedIVFFlatIndex(16, 16, "l2", probe_routing=True)
+    routed.centroids = masked.centroids
+    routed.lists = masked.lists
+    routed._host_rows, routed._host_assign = masked._host_rows, masked._host_assign
+    routed._n = masked._n
+    routed.set_nprobe(6)
+    Dm, Im = masked.search(q, 10)
+    Dr, Ir = routed.search(q, 10)
+    np.testing.assert_array_equal(Im, Ir)
+    np.testing.assert_allclose(Dm, Dr, rtol=1e-3, atol=1e-3)
+
+
+def test_routed_builder(rng):
+    from distributed_faiss_tpu.models.factory import build_index
+    from distributed_faiss_tpu.utils.config import IndexCfg
+
+    cfg = IndexCfg(index_builder_type="ivf_tpu", dim=8, metric="l2",
+                   centroids=8, nprobe=4, shard_lists=True, probe_routing=True)
+    idx = build_index(cfg)
+    assert idx.probe_routing
+    x = rng.standard_normal((900, 8)).astype(np.float32)
+    idx.train(x)
+    idx.add(x)
+    D, I = idx.search(x[:4], 5)
+    assert (I[:, 0] == np.arange(4)).all()
+
+
+@pytest.mark.parametrize("metric", ["dot", "l2"])
 def test_sharded_ivf_pq_matches_single_device(rng, metric):
     """Sharded IVF-PQ == single-device IVF-PQ when sharing trained state."""
     from distributed_faiss_tpu.models.ivf import IVFPQIndex
