@@ -7,19 +7,27 @@ sharded over the ``shard`` axis and all cross-chip traffic expressed as XLA
 collectives (all_gather / psum) that ride ICI — not RPC.
 
 Components:
-- ``make_mesh``            — 1D device mesh over the local chips
-- ``sharded_knn``          — corpus-sharded exact search: each chip scans its
-                             local block (MXU matmul + running top-k), then an
-                             ``all_gather`` of the (nq, k) candidates and a
-                             replicated merge; DCN never sees per-chunk scores
-- ``sharded_kmeans``       — Lloyd iterations with local one-hot-matmul
-                             accumulation and ``psum`` reductions for the
-                             cluster sums/counts (the million-centroid path)
-- ``ShardedFlatIndex``     — a TpuIndex whose corpus lives sharded in the
-                             mesh's HBM; drop-in behind the builder registry
-- ``IvfTpuIndex``          — the ``ivf_tpu`` builder target (BASELINE.json's
-                             north star): IVF whose coarse k-means trains
-                             sharded over the mesh
+- ``make_mesh``             — 1D device mesh over the local chips
+- ``sharded_knn``           — corpus-sharded exact search: each chip scans its
+                              local block (MXU matmul + running top-k), then an
+                              ``all_gather`` of the (nq, k) candidates and a
+                              replicated merge; DCN never sees per-chunk scores
+- ``sharded_kmeans``        — Lloyd iterations with local one-hot-matmul
+                              accumulation and ``psum`` reductions for the
+                              cluster sums/counts (the million-centroid path)
+- ``ShardedFlatIndex``      — exact index whose corpus lives sharded in the
+                              mesh's HBM (incremental device sync)
+- ``IvfTpuIndex``           — the ``ivf_tpu`` builder target (BASELINE.json's
+                              north star): IVF whose coarse k-means trains
+                              sharded over the mesh
+- ``ShardedPaddedLists``    — inverted lists partitioned across chip HBMs
+                              (strided ownership, per-shard drop-routed scatter)
+- ``ShardedIVFFlatIndex``   — IVF over sharded lists; two search modes:
+                              ownership masking (capacity scales) and probe
+                              routing (FLOPs scale too — each chip compacts
+                              and scores only its owned pairs)
+- ``ShardedIVFPQIndex``     — IVF-PQ over sharded code lists (per-chip
+                              residual-LUT ADC, ICI merge)
 
 Tests exercise all of this on a virtual 8-device CPU mesh
 (tests/conftest.py); the driver's dryrun_multichip does the same through
