@@ -79,7 +79,7 @@ def _build_knnlm(cfg: IndexCfg):
     if cfg.extra.get("shard_lists"):
         from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex
 
-        for unsupported in ("pallas_adc", "refine_k_factor", "probe_routing"):
+        for unsupported in ("pallas_adc", "refine_k_factor"):
             if cfg.extra.get(unsupported):
                 logging.getLogger().warning(
                     "%s is not yet supported on the sharded IVF-PQ path; ignored",
@@ -88,6 +88,11 @@ def _build_knnlm(cfg: IndexCfg):
         return ShardedIVFPQIndex(
             cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
             mesh=_mesh(cfg), kmeans_iters=_kmeans_iters(cfg),
+            probe_routing=bool(cfg.extra.get("probe_routing")),
+        )
+    if cfg.extra.get("probe_routing"):
+        logging.getLogger().warning(
+            "probe_routing requires shard_lists=True on the knnlm builder; ignored"
         )
     return IVFPQIndex(cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
                       kmeans_iters=_kmeans_iters(cfg),

@@ -565,32 +565,16 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
             return self._empty_results(q.shape[0], k)
         nprobe = min(self.nprobe, self.nlist)
         if self.probe_routing:
-            S = self.mesh.shape[AXIS]
             # pair group sized so the (group, cap, d) fp32 block stays <=64MB
             group = max(8, min(1024, (64 << 20) // max(1, self.lists.cap * self.dim * 4)))
-            q = np.asarray(q, np.float32)
-            nq = q.shape[0]
-            out_s = np.empty((nq, k), np.float32)
-            out_i = np.empty((nq, k), np.int64)
-            for s0, n, block in base.query_blocks(q):
-                bucket = routed_pair_bucket(block.shape[0], nprobe, S, group)
-                vals, ids, dropped = _sharded_ivf_flat_search_routed(
+            return _routed_search_blocks(
+                self, q, k, nprobe, group,
+                lambda block, n, bucket: _sharded_ivf_flat_search_routed(
                     self.centroids, self.lists.data, self.lists.ids,
-                    self.lists.sizes, jnp.asarray(block), n, self.mesh, k,
-                    nprobe, bucket, group, self.metric,
-                )
-                nd = int(dropped)
-                if nd:
-                    import logging
-
-                    logging.getLogger().warning(
-                        "probe routing dropped %d pairs on the busiest chip "
-                        "(skewed list ownership); raise the slack or disable "
-                        "probe_routing", nd,
-                    )
-                out_s[s0:s0 + n] = np.asarray(vals)[:n]
-                out_i[s0:s0 + n] = np.asarray(ids)[:n]
-            return base.finalize_results(out_s, out_i, self.metric)
+                    self.lists.sizes, block, n, self.mesh, k, nprobe, bucket,
+                    group, self.metric,
+                ),
+            )
         gsz = probe_group_size(nprobe, 256 * self.lists.cap * self.dim * 4)
         return self._search_blocks(
             q, k,
@@ -706,10 +690,12 @@ class ShardedIVFPQIndex(IVFPQIndex):
 
     def __init__(self, dim: int, nlist: int, m: int = 64, nbits: int = 8,
                  metric: str = "l2", mesh: Optional[Mesh] = None,
-                 kmeans_iters: int = 10, pq_iters: int = 15):
+                 kmeans_iters: int = 10, pq_iters: int = 15,
+                 probe_routing: bool = False):
         super().__init__(dim, nlist, m=m, nbits=nbits, metric=metric,
                          kmeans_iters=kmeans_iters, pq_iters=pq_iters)
         self.mesh = mesh or make_mesh()
+        self.probe_routing = probe_routing
 
     def _train_centroids(self, x: np.ndarray):
         self.centroids = sharded_kmeans(self.mesh, x, self.nlist, iters=self.kmeans_iters)
@@ -721,6 +707,17 @@ class ShardedIVFPQIndex(IVFPQIndex):
         if self._n == 0:
             return self._empty_results(q.shape[0], k)
         nprobe = min(self.nprobe, self.nlist)
+        if self.probe_routing:
+            # pair group sized so codes + one-hot transients stay bounded
+            group = max(8, min(512, (32 << 20) // max(1, self.lists.cap * self.m)))
+            return _routed_search_blocks(
+                self, q, k, nprobe, group,
+                lambda block, n, bucket: _sharded_ivf_pq_search_routed(
+                    self.centroids, self.codebooks, self.lists.data,
+                    self.lists.ids, self.lists.sizes, block, n, self.mesh, k,
+                    nprobe, bucket, group, self.metric,
+                ),
+            )
         per_probe = 256 * self.lists.cap * (self.m + 8) + 256 * self.m * 256 * 4
         g = probe_group_size(nprobe, per_probe)
         return self._search_blocks(
@@ -734,12 +731,14 @@ class ShardedIVFPQIndex(IVFPQIndex):
     def state_dict(self):
         state = super().state_dict()
         state["kind"] = "sharded_ivf_pq"
+        state["probe_routing"] = self.probe_routing
         return state
 
     @classmethod
     def from_state_dict(cls, state):
         idx = cls(int(state["dim"]), int(state["nlist"]), m=int(state["m"]),
-                  nbits=int(state["nbits"]), metric=str(state["metric"]))
+                  nbits=int(state["nbits"]), metric=str(state["metric"]),
+                  probe_routing=bool(state.get("probe_routing", False)))
         idx.nprobe = int(state["nprobe"])
         if not bool(state["trained"]):
             return idx
@@ -758,6 +757,98 @@ class ShardedIVFPQIndex(IVFPQIndex):
 # ------------------------------------------------- routed sharded IVF search
 
 
+def _routed_pairs_local(probes, nq_real, nprobe: int, pair_bucket: int,
+                        group: int, k: int, cap: int, S: int, anchor,
+                        score_group):
+    """Shared per-chip body of probe-routed search.
+
+    Compacts this chip's owned (query, probe) pairs into ``pair_bucket``,
+    scores them in ``group``-sized batches via ``score_group(qi, li, slot,
+    valid) -> (scores (g, cap), ids (g, cap))`` (qi = query row, li = global
+    list id, slot = local list slot), reduces to a per-query
+    (nq, k) top-k locally, and merges the (S, nq, k) candidate sets over one
+    all_gather. Returns (vals, ids, dropped)."""
+    nq = probes.shape[0]
+    n_pairs = nq * nprobe
+    ngroups = pair_bucket // group
+    ax = jax.lax.axis_index(AXIS).astype(jnp.int32)
+    flat_li = probes.reshape(n_pairs)
+    # pairs from zero-padded query rows (pad_rows buckets) are excluded:
+    # they would concentrate on a few chips and fire spurious drop warnings
+    real_row = (jnp.arange(n_pairs, dtype=jnp.int32) // nprobe) < nq_real
+    mine = ((flat_li % S) == ax) & real_row
+    owned_count = jnp.sum(mine.astype(jnp.int32))
+    # compact owned pair indices into the fixed bucket (1s sort first; note
+    # top_k breaks ties by lower index, which keeps earlier pairs); pad the
+    # mask when the bucket exceeds the total pair count (small query batches)
+    pad = max(0, pair_bucket - n_pairs)
+    mine_p = jnp.concatenate([mine, jnp.zeros(pad, bool)]) if pad else mine
+    sel_val, sel_idx = jax.lax.top_k(mine_p.astype(jnp.int32), pair_bucket)
+    sel_idx = jnp.minimum(sel_idx, n_pairs - 1)
+    pair_valid = sel_val > 0
+    pair_qi = (sel_idx // nprobe).astype(jnp.int32)   # (B,)
+    pair_li = flat_li[sel_idx]                         # (B,)
+    pair_slot = jnp.where(pair_valid, pair_li // S, 0)
+
+    kk = min(k, cap)
+
+    def body(carry, g_idx):
+        vals_acc, ids_acc = carry
+        s0 = g_idx * group
+        qi = jax.lax.dynamic_slice(pair_qi, (s0,), (group,))
+        li = jax.lax.dynamic_slice(pair_li, (s0,), (group,))
+        slot = jax.lax.dynamic_slice(pair_slot, (s0,), (group,))
+        valid = jax.lax.dynamic_slice(pair_valid, (s0,), (group,))
+        s, ids = score_group(qi, li, slot, valid)      # (g, cap) each
+        pv, pp = jax.lax.top_k(s, kk)                  # per-pair top-k
+        pids = jnp.take_along_axis(ids, pp, axis=1)
+        vals_acc = jax.lax.dynamic_update_slice(vals_acc, pv, (s0, 0))
+        ids_acc = jax.lax.dynamic_update_slice(ids_acc, pids, (s0, 0))
+        return (vals_acc, ids_acc), None
+
+    init = (
+        jnp.full((pair_bucket, kk), distance.NEG_INF, jnp.float32) + anchor,
+        jnp.full((pair_bucket, kk), -1, jnp.int32) + anchor.astype(jnp.int32),
+    )
+    (pair_vals, pair_ids), _ = jax.lax.scan(
+        body, init, jnp.arange(ngroups, dtype=jnp.int32)
+    )
+
+    # reduce THIS chip's pairs to a per-query (nq, k) top-k BEFORE the
+    # all_gather: ICI then carries (S, nq, k) instead of (S, B, kk), and
+    # the replicated final merge is the cheap (nq, S*k) one
+    dropped = jax.lax.pmax(jnp.maximum(owned_count - pair_bucket, 0), AXIS)
+    QB = 16
+    nqb = -(-nq // QB)
+
+    def qmerge(carry, b_idx):
+        out_v, out_i = carry
+        q0 = b_idx * QB
+        qids = q0 + jnp.arange(QB, dtype=jnp.int32)   # (QB,)
+        m = pair_qi[None, :] == qids[:, None]         # (QB, B)
+        mv = jnp.where(m[:, :, None], pair_vals[None, :, :], distance.NEG_INF)
+        mi = jnp.where(m[:, :, None], pair_ids[None, :, :], -1)
+        bv, bp = jax.lax.top_k(mv.reshape(QB, -1), k)
+        bi = jnp.take_along_axis(mi.reshape(QB, -1), bp, axis=1)
+        out_v = jax.lax.dynamic_update_slice(out_v, bv, (q0, 0))
+        out_i = jax.lax.dynamic_update_slice(out_i, bi, (q0, 0))
+        return (out_v, out_i), None
+
+    pad_q = nqb * QB
+    init_q = (
+        jnp.full((pad_q, k), distance.NEG_INF, jnp.float32) + anchor,
+        jnp.full((pad_q, k), -1, jnp.int32) + anchor.astype(jnp.int32),
+    )
+    (loc_v, loc_i), _ = jax.lax.scan(qmerge, init_q, jnp.arange(nqb, dtype=jnp.int32))
+    loc_v, loc_i = loc_v[:nq], loc_i[:nq]
+    av = jax.lax.all_gather(loc_v, AXIS)              # (S, nq, k)
+    ai = jax.lax.all_gather(loc_i, AXIS)
+    fv = jnp.transpose(av, (1, 0, 2)).reshape(nq, -1)
+    fi = jnp.transpose(ai, (1, 0, 2)).reshape(nq, -1)
+    best, pos = jax.lax.top_k(fv, k)
+    return best, jnp.take_along_axis(fi, pos, axis=1), dropped
+
+
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "pair_bucket",
                                              "group", "metric"))
 def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, q,
@@ -767,10 +858,7 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
 
     The masked variant (_sharded_ivf_flat_search) has every chip do the full
     (nq x nprobe) gather/einsum work and zero out non-owned probes. Here each
-    chip first *compacts* the (query, probe) pairs it owns into a fixed
-    ``pair_bucket`` (top_k over the ownership mask — ~(nq*nprobe)/S pairs
-    each), scores only those pairs in ``group``-sized batches, keeps a
-    per-pair top-k, and the per-chip candidates merge over one all_gather.
+    chip scores only the pairs it owns (see _routed_pairs_local).
 
     pair_bucket bounds per-chip work; pairs beyond it are DROPPED (skewed
     ownership). The third return value is the max dropped-pairs count across
@@ -782,39 +870,15 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
     _, probes = jax.lax.top_k(coarse, nprobe)  # (nq, nprobe)
-    nq = q.shape[0]
     cap = list_data.shape[1]
     S = mesh.shape[AXIS]
     qn = jnp.sum(q * q, axis=1, keepdims=True)
-    n_pairs = nq * nprobe
-    ngroups = pair_bucket // group
 
     def local(q, qn, probes, nq_real, data_local, ids_local, sizes_local):
-        ax = jax.lax.axis_index(AXIS).astype(jnp.int32)
-        flat_li = probes.reshape(n_pairs)
-        # pairs from zero-padded query rows (pad_rows buckets) are excluded:
-        # they would concentrate on a few chips and fire spurious drop warnings
-        real_row = (jnp.arange(n_pairs, dtype=jnp.int32) // nprobe) < nq_real
-        mine = ((flat_li % S) == ax) & real_row
-        owned_count = jnp.sum(mine.astype(jnp.int32))
-        # compact owned pair indices into the fixed bucket (1s sort first);
-        # pad the mask when the bucket exceeds the total pair count (small
-        # query batches) — padded picks carry sel_val == 0 and are dropped
-        pad = max(0, pair_bucket - n_pairs)
-        mine_p = jnp.concatenate([mine, jnp.zeros(pad, bool)]) if pad else mine
-        sel_val, sel_idx = jax.lax.top_k(mine_p.astype(jnp.int32), pair_bucket)
-        sel_idx = jnp.minimum(sel_idx, n_pairs - 1)
-        pair_valid = sel_val > 0
-        pair_qi = (sel_idx // nprobe).astype(jnp.int32)   # (B,)
-        pair_li = flat_li[sel_idx]                         # (B,)
-        pair_slot = jnp.where(pair_valid, pair_li // S, 0)
+        anchor = jnp.where(jnp.zeros((), bool),
+                           data_local.reshape(-1)[0].astype(jnp.float32), 0.0)
 
-        def body(carry, g_idx):
-            vals_acc, ids_acc = carry
-            s0 = g_idx * group
-            qi = jax.lax.dynamic_slice(pair_qi, (s0,), (group,))
-            slot = jax.lax.dynamic_slice(pair_slot, (s0,), (group,))
-            valid = jax.lax.dynamic_slice(pair_valid, (s0,), (group,))
+        def score_group(qi, li, slot, valid):
             qv = q[qi]                        # (g, d) gathered queries
             block = data_local[slot].astype(jnp.float32)  # (g, cap, d)
             ids = ids_local[slot]
@@ -828,62 +892,10 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
                 s = -(qn[qi] - 2.0 * ip + bn)
             ok = (jnp.arange(cap)[None, :] < sizes[:, None]) & (ids >= 0)
             ok = ok & valid[:, None]
-            s = jnp.where(ok, s, distance.NEG_INF)
-            ids = jnp.where(ok, ids, -1)
-            pv, pp = jax.lax.top_k(s, min(k, cap))        # per-pair top-k
-            pids = jnp.take_along_axis(ids, pp, axis=1)
-            vals_acc = jax.lax.dynamic_update_slice(vals_acc, pv, (s0, 0))
-            ids_acc = jax.lax.dynamic_update_slice(ids_acc, pids, (s0, 0))
-            return (vals_acc, ids_acc), None
+            return jnp.where(ok, s, distance.NEG_INF), jnp.where(ok, ids, -1)
 
-        kk = min(k, cap)
-        anchor = jnp.where(jnp.zeros((), bool),
-                           data_local.reshape(-1)[0].astype(jnp.float32), 0.0)
-        init = (
-            jnp.full((pair_bucket, kk), distance.NEG_INF, jnp.float32) + anchor,
-            jnp.full((pair_bucket, kk), -1, jnp.int32) + anchor.astype(jnp.int32),
-        )
-        (pair_vals, pair_ids), _ = jax.lax.scan(
-            body, init, jnp.arange(ngroups, dtype=jnp.int32)
-        )
-
-        # reduce THIS chip's pairs to a per-query (nq, k) top-k BEFORE the
-        # all_gather: ICI then carries (S, nq, k) instead of (S, B, kk), and
-        # the replicated final merge is the cheap (nq, S*k) one
-        dropped = jax.lax.pmax(
-            jnp.maximum(owned_count - pair_bucket, 0), AXIS
-        )
-        QB = 16
-        nqb = -(-nq // QB)
-
-        def qmerge(carry, b_idx):
-            out_v, out_i = carry
-            q0 = b_idx * QB
-            qids = q0 + jnp.arange(QB, dtype=jnp.int32)   # (QB,)
-            m = pair_qi[None, :] == qids[:, None]         # (QB, B)
-            mv = jnp.where(m[:, :, None], pair_vals[None, :, :], distance.NEG_INF)
-            mi = jnp.where(m[:, :, None], pair_ids[None, :, :], -1)
-            bv, bp = jax.lax.top_k(mv.reshape(QB, -1), k)
-            bi = jnp.take_along_axis(mi.reshape(QB, -1), bp, axis=1)
-            out_v = jax.lax.dynamic_update_slice(out_v, bv, (q0, 0))
-            out_i = jax.lax.dynamic_update_slice(out_i, bi, (q0, 0))
-            return (out_v, out_i), None
-
-        pad_q = nqb * QB
-        init_q = (
-            jnp.full((pad_q, k), distance.NEG_INF, jnp.float32) + anchor,
-            jnp.full((pad_q, k), -1, jnp.int32) + anchor.astype(jnp.int32),
-        )
-        (loc_v, loc_i), _ = jax.lax.scan(
-            qmerge, init_q, jnp.arange(nqb, dtype=jnp.int32)
-        )
-        loc_v, loc_i = loc_v[:nq], loc_i[:nq]
-        av = jax.lax.all_gather(loc_v, AXIS)              # (S, nq, k)
-        ai = jax.lax.all_gather(loc_i, AXIS)
-        fv = jnp.transpose(av, (1, 0, 2)).reshape(nq, -1)
-        fi = jnp.transpose(ai, (1, 0, 2)).reshape(nq, -1)
-        best, pos = jax.lax.top_k(fv, k)
-        return best, jnp.take_along_axis(fi, pos, axis=1), dropped
+        return _routed_pairs_local(probes, nq_real, nprobe, pair_bucket, group,
+                                   k, cap, S, anchor, score_group)
 
     fn = _shard_map_fn(
         local,
@@ -894,6 +906,85 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
     )
     return fn(q, qn, probes, jnp.asarray(nq_real, jnp.int32),
               list_data, list_ids, list_sizes)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "pair_bucket",
+                                             "group", "metric"))
+def _sharded_ivf_pq_search_routed(centroids, codebooks, list_codes, list_ids,
+                                  list_sizes, q, nq_real, mesh, k: int,
+                                  nprobe: int, pair_bucket: int, group: int,
+                                  metric: str):
+    """Probe-routed sharded IVF-PQ: per-pair residual LUTs + one-hot ADC over
+    owned pairs only (same scaffold as the flat variant)."""
+    from distributed_faiss_tpu.ops import pq as pqops
+
+    q = q.astype(jnp.float32)
+    coarse = distance.pairwise_scores(q, centroids, metric)
+    _, probes = jax.lax.top_k(coarse, nprobe)
+    cap = list_codes.shape[1]
+    S = mesh.shape[AXIS]
+    m, ksub, _ = codebooks.shape
+
+    def local(q, probes, nq_real, codes_local, ids_local, sizes_local):
+        anchor = jnp.where(jnp.zeros((), bool),
+                           codes_local.reshape(-1)[0].astype(jnp.float32), 0.0)
+
+        def score_group(qi, li, slot, valid):
+            qv = q[qi]                                   # (g, d)
+            if metric == "l2":
+                r = qv - centroids[li]                   # per-pair residual
+            else:
+                r = qv
+            lut = pqops.adc_lut(r, codebooks, metric=metric)  # (g, m, ksub)
+            codes = codes_local[slot]                    # (g, cap, m)
+            iota = jnp.arange(ksub, dtype=jnp.int32)
+            onehot = (codes[..., None].astype(jnp.int32) == iota).astype(jnp.float32)
+            s = jnp.einsum("gmj,gcmj->gc", lut, onehot, precision=_HIGHEST,
+                           preferred_element_type=jnp.float32)
+            ids = ids_local[slot]
+            sizes = sizes_local[slot]
+            ok = (jnp.arange(cap)[None, :] < sizes[:, None]) & (ids >= 0)
+            ok = ok & valid[:, None]
+            return jnp.where(ok, s, distance.NEG_INF), jnp.where(ok, ids, -1)
+
+        return _routed_pairs_local(probes, nq_real, nprobe, pair_bucket, group,
+                                   k, cap, S, anchor, score_group)
+
+    fn = _shard_map_fn(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return fn(q, probes, jnp.asarray(nq_real, jnp.int32),
+              list_codes, list_ids, list_sizes)
+
+
+def _routed_search_blocks(index, q, k: int, nprobe: int, group: int, call):
+    """Shared block-loop driver for probe-routed searches.
+
+    ``call(block, nq_real, bucket) -> (vals, ids, dropped)``. Handles query
+    bucketing, the dropped-pairs warning, and FAISS-style finalization."""
+    import logging
+
+    S = index.mesh.shape[AXIS]
+    q = np.asarray(q, np.float32)
+    nq = q.shape[0]
+    out_s = np.empty((nq, k), np.float32)
+    out_i = np.empty((nq, k), np.int64)
+    for s0, n, block in base.query_blocks(q):
+        bucket = routed_pair_bucket(block.shape[0], nprobe, S, group)
+        vals, ids, dropped = call(jnp.asarray(block), n, bucket)
+        nd = int(dropped)
+        if nd:
+            logging.getLogger().warning(
+                "probe routing dropped %d pairs on the busiest chip (skewed "
+                "list ownership); raise the slack or disable probe_routing", nd,
+            )
+        out_s[s0:s0 + n] = np.asarray(vals)[:n]
+        out_i[s0:s0 + n] = np.asarray(ids)[:n]
+    return base.finalize_results(out_s, out_i, index.metric)
 
 
 def routed_pair_bucket(nq: int, nprobe: int, S: int, group: int, slack: float = 2.0):

@@ -194,6 +194,29 @@ def test_sharded_ivf_pq_matches_single_device(rng, metric):
     np.testing.assert_allclose(Ds, Du, rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.parametrize("metric", ["dot", "l2"])
+def test_routed_pq_matches_masked(rng, metric):
+    from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex
+
+    d, m = 32, 8
+    x = rng.standard_normal((2000, d)).astype(np.float32)
+    q = rng.standard_normal((9, d)).astype(np.float32)
+    masked = ShardedIVFPQIndex(d, 8, m=m, metric=metric)
+    masked.train(x)
+    masked.add(x)
+    masked.set_nprobe(5)
+    routed = ShardedIVFPQIndex(d, 8, m=m, metric=metric, probe_routing=True)
+    routed.centroids, routed.codebooks = masked.centroids, masked.codebooks
+    routed.lists = masked.lists
+    routed._host_rows, routed._host_assign = masked._host_rows, masked._host_assign
+    routed._n = masked._n
+    routed.set_nprobe(5)
+    Dm, Im = masked.search(q, 10)
+    Dr, Ir = routed.search(q, 10)
+    np.testing.assert_array_equal(Im, Ir)
+    np.testing.assert_allclose(Dm, Dr, rtol=1e-3, atol=1e-3)
+
+
 def test_sharded_ivf_pq_lifecycle(rng, tmp_path):
     from distributed_faiss_tpu.models.factory import build_index, index_from_state_dict
     from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex
