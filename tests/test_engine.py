@@ -132,6 +132,26 @@ def test_ivf_engine_centroids(rng, tmp_path):
     assert idx.tpu_index.nprobe == 4
 
 
+def test_train_ratio_when_train_num_zero(rng, caplog):
+    """train_num=0 + train_ratio<1 -> ratio x buffered rows used for training
+    (reference index.py:199-206); trigger must then come via sync_train."""
+    import logging
+
+    idx = Index(flat_cfg(train_num=0, train_ratio=0.5))
+    x = rng.standard_normal((100, 16)).astype(np.float32)
+    idx.add_batch(x, None, train_async_if_triggered=False)
+    # train_num == 0 never auto-triggers (reference: `0 < train_num <= total`)
+    assert idx.get_state() == IndexState.NOT_TRAINED
+    with caplog.at_level(logging.INFO):
+        idx.sync_train()
+    assert wait_state(idx, IndexState.TRAINED)
+    buf, indexed = idx.get_idx_data_num()
+    assert (buf, indexed) == (0, 100)
+    # the ratio must be observable: exactly 50 of 100 rows went to training
+    assert any("(50, 16)" in r.getMessage() for r in caplog.records), \
+        [r.getMessage() for r in caplog.records][:5]
+
+
 def test_infer_centroids_tiers():
     assert infer_n_centroids(10000) == int(2 * 100)
     assert infer_n_centroids(2_000_000) == 65536
