@@ -21,6 +21,7 @@ lists); lists are rebuilt by one bulk append on load.
 """
 
 import functools
+import logging
 from typing import Dict, Optional
 
 import jax
@@ -29,6 +30,8 @@ import numpy as np
 
 from distributed_faiss_tpu.models import base
 from distributed_faiss_tpu.ops import distance, kmeans, pq, sq
+
+logger = logging.getLogger()
 
 _HIGHEST = jax.lax.Precision.HIGHEST
 
@@ -382,6 +385,7 @@ class IVFPQIndex(_IVFBase):
         self.nbits = nbits
         self.pq_iters = pq_iters
         self.use_pallas = use_pallas  # fused ADC kernel instead of XLA one-hot
+        self._pallas_runtime_ok = True  # runtime disable, not persisted
         # refine_k_factor > 0: keep fp16 raw rows in HBM and exactly rescore
         # the top k*refine_k_factor ADC candidates (FAISS IndexRefine-style;
         # what lifts PQ configs past recall 0.95)
@@ -436,12 +440,35 @@ class IVFPQIndex(_IVFBase):
         g = probe_group_size(nprobe, per_probe)
         adc_k = k * self.refine_k_factor if self.refine_k_factor else k
 
-        def run(b):
-            vals, ids = _ivf_pq_search(
+        def adc(b, with_pallas):
+            return _ivf_pq_search(
                 self.centroids, self.codebooks, self.lists.data, self.lists.ids,
                 self.lists.sizes, b, adc_k, nprobe, g, self.metric,
-                use_pallas=self.use_pallas,
+                use_pallas=with_pallas,
             )
+
+        def run(b):
+            with_pallas = self.use_pallas and self._pallas_runtime_ok
+            try:
+                vals, ids = adc(b, with_pallas)
+                # surface asynchronous execution faults inside this try —
+                # otherwise a runtime kernel abort raises later at the
+                # np.asarray in _search_blocks, past the fallback
+                jax.block_until_ready((vals, ids))
+            except Exception:
+                if not with_pallas:
+                    raise
+                # only conclude the kernel is at fault if the XLA path
+                # succeeds where pallas failed; a user error (bad dim etc.)
+                # re-raises from the retry with use_pallas intent intact
+                vals, ids = adc(b, False)
+                jax.block_until_ready((vals, ids))
+                logger.exception(
+                    "pallas ADC kernel failed on this backend; using the XLA "
+                    "path for the rest of this process (persisted use_pallas "
+                    "intent is unchanged)"
+                )
+                self._pallas_runtime_ok = False
             if self.refine_k_factor:
                 vals, ids = _rerank_exact(self.refine_store.data, b, ids, k, self.metric)
             return vals, ids
