@@ -326,6 +326,14 @@ class IndexClient:
     def get_ntotal(self, index_id: str) -> int:
         return sum(self.pool.map(lambda idx: idx.get_ntotal(index_id), self.sub_indexes))
 
+    def get_buffer_depth(self, index_id: str) -> int:
+        """Cluster-wide count of buffered-but-unindexed vectors (sums the
+        per-rank get_aggregated_ntotal RPC — the reference exposes it only
+        per-server, server.py:268-272). Zero + TRAINED == fully indexed."""
+        return sum(self.pool.map(
+            lambda idx: idx.get_aggregated_ntotal(index_id), self.sub_indexes
+        ))
+
     def get_ids(self, index_id: str) -> set:
         id_sets = self.pool.map(lambda idx: idx.get_ids(index_id), self.sub_indexes)
         return set().union(*id_sets)

@@ -106,8 +106,14 @@ class IndexServer:
 
     def get_aggregated_ntotal(self, index_id: str) -> int:
         """Buffer depth, i.e. not-yet-indexed vectors (reference
-        server.py:268-272 returns the buffer size under this name)."""
-        return self._get_index(index_id).get_idx_data_num()[0]
+        server.py:268-272 returns the buffer size under this name).
+        Missing index -> 0, matching get_ntotal's degradation so
+        monitoring can poll both through drop/recreate windows."""
+        with self.indexes_lock:
+            if index_id not in self.indexes:
+                return 0
+            index = self.indexes[index_id]
+        return index.get_idx_data_num()[0]
 
     def save_index(self, index_id: str) -> None:
         self._get_index(index_id).save()

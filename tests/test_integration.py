@@ -315,6 +315,20 @@ def test_selector_server_mode(tmp_path, rng):
     client.close()
 
 
+def test_buffer_depth(cluster, rng, request):
+    index_id = request.node.name
+    client = IndexClient(cluster["multi"])
+    client.create_index(index_id, flat_cfg(train_num=10_000))  # never auto-trains
+    x = rng.standard_normal((120, 16)).astype(np.float32)
+    fill(client, index_id, x, list(range(120)), bs=30)
+    assert client.get_buffer_depth(index_id) == 120  # all buffered, none indexed
+    client.sync_train(index_id)
+    assert wait_trained(client, index_id)
+    assert client.get_buffer_depth(index_id) == 0
+    assert client.get_ntotal(index_id) == 120
+    client.close()
+
+
 def test_ping_health(cluster, rng, request):
     index_id = request.node.name
     client = IndexClient(cluster["multi"])
