@@ -10,6 +10,10 @@ via ctypes, with the SQ8 codec trained in numpy.
 The shared library is compiled on first use with g++ (cached next to the
 source; rebuilt when the source is newer). If no C++ toolchain is available
 the factory falls back to the exact sq8 flat scan (models/flat.py).
+
+Concurrency: the graph's search scratch is shared, so calls on one
+HNSWSQIndex must not overlap — the engine's index_lock guarantees this in
+the serving path; direct users must serialize searches per instance.
 """
 
 import ctypes

@@ -12,6 +12,12 @@
 // reference asserts L2 for hnswsq too).
 //
 // C API at the bottom (ctypes-consumed by models/hnsw.py).
+//
+// Thread-safety: search() reuses a shared visited-epoch scratch, so
+// concurrent searches on ONE graph are NOT safe; the serving engine already
+// serializes per-index device/search calls via its index_lock (the same
+// discipline the reference applies to FAISS, index.py:246-252). Distinct
+// HNSW instances are independent.
 
 #include <algorithm>
 #include <cmath>
