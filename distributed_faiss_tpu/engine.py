@@ -309,7 +309,11 @@ class Index:
 
     def get_ids(self) -> set:
         id_idx = self.cfg.custom_meta_id_idx
-        return {meta[id_idx] for meta in self.id_to_metadata if meta}
+        # id_to_metadata is extended under buffer_lock (add_index_data); take
+        # it here too so a concurrent add can't give a torn read (reference
+        # does the same, index.py:367-368)
+        with self.buffer_lock:
+            return {meta[id_idx] for meta in self.id_to_metadata if meta}
 
     def upd_cfg(self, cfg: IndexCfg) -> None:
         self.cfg = cfg
@@ -357,14 +361,16 @@ class Index:
                     os.fsync(f.fileno())
                 os.replace(tmp, path)
 
-            # rename order matters across the SET: meta and buffer land
-            # before the index so any crash point keeps the load invariant
-            # len(meta) >= index.ntotal (worst case: newer meta with an older
-            # index -> from_storage_dir truncates gracefully)
+            # rename order matters across the SET: meta, buffer and cfg all
+            # land before the index, so at any crash point the files that
+            # describe an index are never older than the index itself —
+            # load invariant len(meta) >= index.ntotal holds (worst case:
+            # newer meta/cfg with an older index -> from_storage_dir
+            # truncates meta gracefully, cfg knobs apply to the older index)
             _atomic(meta_file, lambda f: pickle.dump(self.id_to_metadata, f), "wb")
             _atomic(buffer_file, lambda f: pickle.dump(self.embeddings_buffer, f), "wb")
-            _atomic(index_file, lambda f: save_state(f, self.tpu_index.state_dict()), "wb")
             _atomic(cfg_file, lambda f: f.write(self.cfg.to_json_string() + "\n"), "w")
+            _atomic(index_file, lambda f: save_state(f, self.tpu_index.state_dict()), "wb")
 
             self.index_saved_size = self.tpu_index.ntotal
             self.index_save_time = time.time()

@@ -1,0 +1,48 @@
+"""Environment scrubbing for the fragile TPU-relay container.
+
+The container reaches its TPU through a harness-owned stdio relay; when that
+relay is dead, the axon PJRT plugin (registered by a sitecustomize whenever
+``PALLAS_AXON_*`` env vars are set) blocks the first ``import jax`` forever.
+Every entry point that must run regardless of relay state (driver dryrun,
+bench fallback, tests) builds its child environment through this one helper
+so the scrub rules live in a single place.
+"""
+
+import os
+
+
+def scrubbed_cpu_env(n_devices=None, base_env=None, extra_pythonpath=None):
+    """Return an env dict that forces jax onto the host CPU platform.
+
+    - strips every ``PALLAS_AXON*`` / ``AXON_*`` var (the relay plugin trigger)
+    - drops the plugin-registering ``.axon_site`` entry from PYTHONPATH
+    - sets ``JAX_PLATFORMS=cpu``
+    - when ``n_devices`` is given, forces that many virtual host devices
+      via ``XLA_FLAGS`` (replacing any existing device-count flag)
+    """
+    src = dict(os.environ if base_env is None else base_env)
+    env = {
+        k: v
+        for k, v in src.items()
+        if not (k.startswith("PALLAS_AXON") or k.startswith("AXON_"))
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+
+    if n_devices is not None:
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={max(int(n_devices), 1)}")
+        env["XLA_FLAGS"] = " ".join(flags)
+
+    pyp = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    ]
+    if extra_pythonpath:
+        pyp = [extra_pythonpath] + pyp
+    env["PYTHONPATH"] = os.pathsep.join(pyp)
+    return env

@@ -113,6 +113,37 @@ def test_save_load_round_trip(rng, tmp_path):
     assert loaded.cfg.train_num == 20
 
 
+def test_save_rename_order(rng, tmp_path, monkeypatch):
+    """Pin the checkpoint crash-point invariant: every file describing the
+    index (meta, buffer, cfg) must be renamed into place BEFORE the index
+    itself, so a crash at any point never leaves a new index with stale
+    metadata or stale cfg knobs."""
+    import os as _os
+
+    order = []
+    real_replace = _os.replace
+
+    checkpoint_files = {"index.npz", "meta.pkl", "buffer.pkl", "cfg.json"}
+
+    def spy(src, dst):
+        # the spy patches the process-global os module: record only the
+        # checkpoint renames, not unrelated library activity
+        if _os.path.basename(dst) in checkpoint_files:
+            order.append(_os.path.basename(dst))
+        return real_replace(src, dst)
+
+    monkeypatch.setattr("distributed_faiss_tpu.engine.os.replace", spy)
+    storage = str(tmp_path / "ord")
+    idx = Index(flat_cfg(train_num=10, index_storage_dir=storage))
+    idx.add_batch(rng.standard_normal((20, 16)).astype(np.float32), None,
+                  train_async_if_triggered=False)
+    assert wait_state(idx, IndexState.TRAINED)
+    assert idx.save()
+    assert order.index("index.npz") == len(order) - 1, order
+    for first in ("meta.pkl", "buffer.pkl", "cfg.json"):
+        assert order.index(first) < order.index("index.npz"), order
+
+
 def test_load_missing_returns_none(tmp_path):
     assert Index.from_storage_dir(str(tmp_path / "nope")) is None
 
@@ -164,6 +195,31 @@ def test_get_ids_custom_idx(rng):
     x = rng.standard_normal((20, 16)).astype(np.float32)
     idx.add_batch(x, [("a", 100 + i) for i in range(20)], train_async_if_triggered=False)
     assert idx.get_ids() == set(range(100, 120))
+
+
+def test_trained_but_empty_return_embeddings(rng):
+    """Pin the trained-but-empty window semantics (engine.py search):
+    search on a trained index with ntotal==0 returns all-(-1) ids, None
+    metadata, and ZERO-filled embeddings — a documented divergence from
+    FAISS search_and_reconstruct (reference index.py:246-260), which never
+    exposes this window because its add is synchronous."""
+    from distributed_faiss_tpu.models.factory import build_index
+
+    cfg = flat_cfg(train_num=10)
+    idx = Index(cfg)
+    # construct the window directly: trained engine whose async add has not
+    # drained yet (tpu_index exists, ntotal == 0)
+    idx.tpu_index = build_index(cfg)
+    idx.tpu_index.train(rng.standard_normal((10, 16)).astype(np.float32))
+    idx.state = IndexState.TRAINED
+
+    q = rng.standard_normal((2, 16)).astype(np.float32)
+    scores, meta, embs = idx.search(q, 3, return_embeddings=True)
+    assert all(m is None for row in meta for m in row)
+    assert len(embs) == 2 and len(embs[0]) == 3
+    for row in embs:
+        for e in row:
+            np.testing.assert_array_equal(e, np.zeros(16, np.float32))
 
 
 def test_drop_index(rng):
