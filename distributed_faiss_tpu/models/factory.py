@@ -79,16 +79,12 @@ def _build_knnlm(cfg: IndexCfg):
     if cfg.extra.get("shard_lists"):
         from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex
 
-        for unsupported in ("pallas_adc", "refine_k_factor"):
-            if cfg.extra.get(unsupported):
-                logging.getLogger().warning(
-                    "%s is not yet supported on the sharded IVF-PQ path; ignored",
-                    unsupported,
-                )
         return ShardedIVFPQIndex(
             cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
             mesh=_mesh(cfg), kmeans_iters=_kmeans_iters(cfg),
             probe_routing=bool(cfg.extra.get("probe_routing")),
+            use_pallas=bool(cfg.extra.get("pallas_adc", False)),
+            refine_k_factor=int(cfg.extra.get("refine_k_factor", 0)),
         )
     if cfg.extra.get("probe_routing"):
         logging.getLogger().warning(

@@ -11,9 +11,14 @@ The shared library is compiled on first use with g++ (cached next to the
 source; rebuilt when the source is newer). If no C++ toolchain is available
 the factory falls back to the exact sq8 flat scan (models/flat.py).
 
-Concurrency: the graph's search scratch is shared, so calls on one
-HNSWSQIndex must not overlap — the engine's index_lock guarantees this in
-the serving path; direct users must serialize searches per instance.
+Concurrency: graph construction is multi-threaded (striped per-node locks,
+fixed-capacity atomic adjacency — the same discipline FAISS's OpenMP HNSW
+uses), batched searches fan out over a thread pool, and concurrent
+``search`` calls on one instance are safe (per-call pooled visited tables;
+ctypes releases the GIL for the duration of the native call). The one
+exclusion callers must keep: ``add`` must not overlap ``search``/``save``
+on the same instance — the engine's index_lock already guarantees that in
+the serving path. Thread count: DFT_HNSW_THREADS env or ``set_threads``.
 """
 
 import ctypes
@@ -58,6 +63,7 @@ def load_library():
         lib.dft_hnsw_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint]
         lib.dft_hnsw_free.argtypes = [ctypes.c_void_p]
         lib.dft_hnsw_set_codec.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.dft_hnsw_set_threads.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.dft_hnsw_add.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
         lib.dft_hnsw_size.restype = ctypes.c_int
         lib.dft_hnsw_size.argtypes = [ctypes.c_void_p]
@@ -97,6 +103,11 @@ class HNSWSQIndex(base.TpuIndex):
         self._h = self._lib.dft_hnsw_create(dim, M, ef_construction, seed)
         self.sq_params = None  # {"vmin": (d,), "step": (d,)} fp32
         self._host_codes = []  # insertion-order mirror for reconstruct
+
+    def set_threads(self, n: int) -> None:
+        """Cap the native thread pool (<=0 restores the default:
+        DFT_HNSW_THREADS env or hardware concurrency)."""
+        self._lib.dft_hnsw_set_threads(self._h, int(n))
 
     def __del__(self):
         h, self._h = getattr(self, "_h", None), None

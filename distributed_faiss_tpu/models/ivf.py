@@ -42,6 +42,24 @@ def _coarse_assign(centroids, x, metric: str):
     return jnp.argmax(s, axis=1).astype(jnp.int32)
 
 
+def exact_candidate_scores(q, rows, metric: str):
+    """Exact (nq, R) scores of gathered candidate rows, higher-is-better.
+
+    The one scoring formula shared by every exact-refine site (single-device
+    _rerank_exact and both sharded pre-merge reranks in parallel/mesh.py):
+    fp32 HIGHEST einsum; dot = ip, l2 = -(qn - 2 ip + rn).
+    """
+    q = q.astype(jnp.float32)
+    rows = rows.astype(jnp.float32)
+    ip = jnp.einsum("qd,qrd->qr", q, rows, precision=_HIGHEST,
+                    preferred_element_type=jnp.float32)
+    if metric == "dot":
+        return ip
+    qn = jnp.sum(q * q, axis=1, keepdims=True)
+    rn = jnp.sum(rows * rows, axis=2)
+    return -(qn - 2.0 * ip + rn)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
 def _rerank_exact(store, q, cand_ids, k: int, metric: str):
     """Exact refine of an ADC shortlist (FAISS IndexRefine-style).
@@ -51,17 +69,9 @@ def _rerank_exact(store, q, cand_ids, k: int, metric: str):
     gathers are DMA-friendly, unlike the element gathers ADC avoids),
     rescans exactly in fp32, returns the top-k re-ordered subset.
     """
-    q = q.astype(jnp.float32)
     safe = jnp.where(cand_ids >= 0, cand_ids, 0)
-    rows = store[safe].astype(jnp.float32)  # (nq, R, d)
-    ip = jnp.einsum("qd,qrd->qr", q, rows, precision=_HIGHEST,
-                    preferred_element_type=jnp.float32)
-    if metric == "dot":
-        s = ip
-    else:
-        qn = jnp.sum(q * q, axis=1, keepdims=True)
-        rn = jnp.sum(rows * rows, axis=2)
-        s = -(qn - 2.0 * ip + rn)
+    rows = store[safe]  # (nq, R, d)
+    s = exact_candidate_scores(q, rows, metric)
     s = jnp.where(cand_ids >= 0, s, distance.NEG_INF)
     best, pos = jax.lax.top_k(s, k)
     return best, jnp.take_along_axis(cand_ids, pos, axis=1)
@@ -239,6 +249,7 @@ class _IVFBase(base.TpuIndex):
         rows = self._encode(x, assign)
         gids = np.arange(self._n, self._n + x.shape[0], dtype=np.int64)
         self.lists.append(assign, rows, gids)
+        self._append_extra(x, assign, gids)
         self._host_rows.append(rows)
         self._host_assign.append(assign)
         self._n += x.shape[0]
@@ -270,6 +281,16 @@ class _IVFBase(base.TpuIndex):
     # subclass hooks
     def _encode(self, x: np.ndarray, assign: np.ndarray) -> np.ndarray:
         raise NotImplementedError
+
+    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray) -> None:
+        """Hook: store side-car payloads (e.g. raw rows for exact refine)."""
+
+
+def clip_f16(x: np.ndarray) -> np.ndarray:
+    """fp32 -> fp16 with clipping: an out-of-range component would store inf
+    and poison that row's refined score to -inf forever."""
+    f16max = np.float16(np.finfo(np.float16).max)
+    return np.clip(np.asarray(x, np.float32), -f16max, f16max).astype(np.float16)
 
 
 class IVFFlatIndex(_IVFBase):
@@ -420,15 +441,9 @@ class IVFPQIndex(_IVFBase):
             x = x - np.asarray(self.centroids)[assign]
         return np.asarray(pq.pq_encode(jnp.asarray(x), self.codebooks))
 
-    def add(self, x: np.ndarray) -> None:
-        super().add(x)
+    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray) -> None:
         if self.refine_store is not None:
-            # clip into fp16 range: an out-of-range component would store inf
-            # and poison that row's refined score to -inf forever
-            f16max = np.float16(np.finfo(np.float16).max)
-            self.refine_store.add(
-                np.clip(np.asarray(x, np.float32), -f16max, f16max).astype(np.float16)
-            )
+            self.refine_store.add(clip_f16(x))
 
     def search(self, q: np.ndarray, k: int):
         if self._n == 0:

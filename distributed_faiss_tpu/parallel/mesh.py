@@ -35,6 +35,7 @@ __graft_entry__.py.
 """
 
 import functools
+import logging
 from typing import Dict, Optional
 
 import jax
@@ -48,10 +49,12 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_fn
 
 from distributed_faiss_tpu.models import base
+from distributed_faiss_tpu.models import ivf as ivfmod
 from distributed_faiss_tpu.models.ivf import IVFFlatIndex, IVFPQIndex, probe_group_size
 from distributed_faiss_tpu.ops import distance
 
 _HIGHEST = jax.lax.Precision.HIGHEST
+logger = logging.getLogger(__name__)
 
 AXIS = "shard"
 
@@ -608,13 +611,27 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
         return idx
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric"))
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric",
+                                             "use_pallas", "adc_k"))
 def _sharded_ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_sizes,
-                           q, mesh, k: int, nprobe: int, g: int, metric: str):
+                           q, mesh, k: int, nprobe: int, g: int, metric: str,
+                           use_pallas: bool = False, adc_k: int = 0,
+                           raw_data=None):
     """IVF-PQ with mesh-sharded code lists: per-chip ADC over owned probes
     (residual LUTs for l2 computed locally against replicated centroids),
     ICI all_gather merge. Same ownership masking trade-off as
-    _sharded_ivf_flat_search."""
+    _sharded_ivf_flat_search.
+
+    use_pallas swaps the one-hot einsum for the fused VMEM ADC kernel.
+
+    adc_k/raw_data enable exact refine (FAISS IndexRefine-style): the scan
+    tracks LOCAL cell positions, keeps a per-chip ADC shortlist of adc_k
+    (= k * refine_k_factor), rescores it exactly against the chip's raw fp16
+    rows (raw_data, same padded-list layout as the codes), and only then
+    merges top-k over ICI. Per-chip top-adc_k is a superset of this chip's
+    contribution to the global ADC top-adc_k, so recall >= the unsharded
+    refine path's; the ICI still carries only (S, nq, k).
+    """
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
     _, probes = jax.lax.top_k(coarse, nprobe)
@@ -623,20 +640,21 @@ def _sharded_ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_size
     m, ksub, _ = codebooks.shape
     S = mesh.shape[AXIS]
     groups = probes.reshape(nq, nprobe // g, g).transpose(1, 0, 2)
+    local_k = adc_k if raw_data is not None else k
 
     from distributed_faiss_tpu.ops import pq as pqops
 
     if metric != "l2":
         shared_lut = pqops.adc_lut(q, codebooks, metric=metric)
 
-    def local(q, groups, codes_local, ids_local, sizes_local):
+    def local(q, groups, codes_local, ids_local, sizes_local, raw_local):
         ax = jax.lax.axis_index(AXIS).astype(jnp.int32)
         # never-taken select: vma-consistent scan carry (see flat variant)
         anchor = jnp.where(jnp.zeros((), bool),
                            codes_local.reshape(-1)[0].astype(jnp.float32), 0.0)
         init = (
-            jnp.full((nq, k), distance.NEG_INF, jnp.float32) + anchor,
-            jnp.full((nq, k), -1, jnp.int32) + anchor.astype(jnp.int32),
+            jnp.full((nq, local_k), distance.NEG_INF, jnp.float32) + anchor,
+            jnp.full((nq, local_k), -1, jnp.int32) + anchor.astype(jnp.int32),
         )
 
         def body(carry, li):  # (nq, g) global list ids
@@ -651,28 +669,59 @@ def _sharded_ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_size
                 lut = lut.reshape(nq, g, m, ksub)
             else:
                 lut = jnp.broadcast_to(shared_lut[:, None], (nq, g, m, ksub))
-            iota = jnp.arange(ksub, dtype=jnp.int32)
-            onehot = (codes[..., None].astype(jnp.int32) == iota).astype(jnp.float32)
-            s = jnp.einsum("qgmj,qgcmj->qgc", lut, onehot, precision=_HIGHEST,
-                           preferred_element_type=jnp.float32)
+            if use_pallas:
+                from distributed_faiss_tpu.ops import adc_pallas
+
+                s = adc_pallas.adc_scan_auto(
+                    lut.reshape(nq * g, m, ksub), codes.reshape(nq * g, cap, m)
+                ).reshape(nq, g, cap)
+            else:
+                iota = jnp.arange(ksub, dtype=jnp.int32)
+                onehot = (codes[..., None].astype(jnp.int32) == iota).astype(jnp.float32)
+                s = jnp.einsum("qgmj,qgcmj->qgc", lut, onehot, precision=_HIGHEST,
+                               preferred_element_type=jnp.float32)
             valid = (jnp.arange(cap)[None, None, :] < sizes[:, :, None])
             valid = valid & (ids >= 0) & mine[:, :, None]
             s = jnp.where(valid, s, distance.NEG_INF)
-            ids = jnp.where(valid, ids, -1)
-            cv, cp = jax.lax.top_k(s.reshape(nq, g * cap), min(k, g * cap))
-            cids = jnp.take_along_axis(ids.reshape(nq, g * cap), cp, axis=1)
-            return distance.merge_topk(carry[0], carry[1], cv, cids, k), None
+            # carry LOCAL cell positions, not global ids: one position
+            # addresses both ids_local and raw_local for the post-scan
+            # gathers (ids always; raw rows when refining)
+            pos = slot[:, :, None] * cap + jnp.arange(cap, dtype=jnp.int32)[None, None, :]
+            pos = jnp.where(valid, pos, -1)
+            cv, cp = jax.lax.top_k(s.reshape(nq, g * cap), min(local_k, g * cap))
+            cpos = jnp.take_along_axis(pos.reshape(nq, g * cap), cp, axis=1)
+            return distance.merge_topk(carry[0], carry[1], cv, cpos, local_k), None
 
-        (vals, ids), _ = jax.lax.scan(body, init, groups)
+        (vals, pos), _ = jax.lax.scan(body, init, groups)
+        safe = jnp.where(pos >= 0, pos, 0)
+        ids = jnp.where(pos >= 0, ids_local.reshape(-1)[safe], -1)
+        if raw_local is not None:
+            # exact rerank of this chip's shortlist BEFORE the merge: the
+            # ICI then carries already-exact (nq, k) candidates
+            rows = raw_local.reshape(-1, raw_local.shape[-1])[safe]
+            s = ivfmod.exact_candidate_scores(q, rows, metric)
+            s = jnp.where(pos >= 0, s, distance.NEG_INF)
+            vals, best = jax.lax.top_k(s, k)
+            ids = jnp.take_along_axis(ids, best, axis=1)
         av = jax.lax.all_gather(vals, AXIS)
         ai = jax.lax.all_gather(ids, AXIS)
         fv = jnp.transpose(av, (1, 0, 2)).reshape(nq, -1)
         fi = jnp.transpose(ai, (1, 0, 2)).reshape(nq, -1)
-        best, pos = jax.lax.top_k(fv, k)
-        return best, jnp.take_along_axis(fi, pos, axis=1)
+        best, pick = jax.lax.top_k(fv, k)
+        return best, jnp.take_along_axis(fi, pick, axis=1)
 
+    if raw_data is not None:
+        fn = _shard_map_fn(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS),
+                      P(AXIS, None, None)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return fn(q, groups, list_codes, list_ids, list_sizes, raw_data)
     fn = _shard_map_fn(
-        local,
+        lambda a, b, c, d, e: local(a, b, c, d, e, None),
         mesh=mesh,
         in_specs=(P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS)),
         out_specs=(P(), P()),
@@ -691,9 +740,16 @@ class ShardedIVFPQIndex(IVFPQIndex):
     def __init__(self, dim: int, nlist: int, m: int = 64, nbits: int = 8,
                  metric: str = "l2", mesh: Optional[Mesh] = None,
                  kmeans_iters: int = 10, pq_iters: int = 15,
-                 probe_routing: bool = False):
+                 probe_routing: bool = False, use_pallas: bool = False,
+                 refine_k_factor: int = 0):
         super().__init__(dim, nlist, m=m, nbits=nbits, metric=metric,
-                         kmeans_iters=kmeans_iters, pq_iters=pq_iters)
+                         kmeans_iters=kmeans_iters, pq_iters=pq_iters,
+                         use_pallas=use_pallas, refine_k_factor=refine_k_factor)
+        # the single-device refine store the parent builds is replaced by a
+        # mesh-sharded raw-row store laid out exactly like the code lists
+        self.refine_store = None
+        self.raw_lists: Optional[ShardedPaddedLists] = None
+        self._host_raw = []  # fp16 raw-row chunks in id order (persistence)
         self.mesh = mesh or make_mesh()
         self.probe_routing = probe_routing
 
@@ -701,56 +757,123 @@ class ShardedIVFPQIndex(IVFPQIndex):
         self.centroids = sharded_kmeans(self.mesh, x, self.nlist, iters=self.kmeans_iters)
 
     def _make_lists(self):
+        if self.refine_k_factor:
+            self.raw_lists = ShardedPaddedLists(
+                self.nlist, (self.dim,), np.float16, self.mesh
+            )
         return ShardedPaddedLists(self.nlist, (self.m,), np.uint8, self.mesh)
+
+    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray):
+        if self.raw_lists is not None:
+            from distributed_faiss_tpu.models.ivf import clip_f16
+
+            raw = clip_f16(x)
+            # identical (assign, gids) stream as the code lists -> identical
+            # slot layout and capacity, so one local position addresses both
+            self.raw_lists.append(assign, raw, gids)
+            self._host_raw.append(raw)
 
     def search(self, q: np.ndarray, k: int):
         if self._n == 0:
             return self._empty_results(q.shape[0], k)
         nprobe = min(self.nprobe, self.nlist)
-        if self.probe_routing:
-            # pair group sized so codes + one-hot transients stay bounded
-            group = max(8, min(512, (32 << 20) // max(1, self.lists.cap * self.m)))
-            return _routed_search_blocks(
-                self, q, k, nprobe, group,
-                lambda block, n, bucket: _sharded_ivf_pq_search_routed(
-                    self.centroids, self.codebooks, self.lists.data,
-                    self.lists.ids, self.lists.sizes, block, n, self.mesh, k,
-                    nprobe, bucket, group, self.metric,
-                ),
+        refining = bool(self.refine_k_factor) and self.raw_lists is not None
+        if refining:
+            assert self.raw_lists.cap == self.lists.cap, (
+                "raw/code list capacities diverged"
             )
-        per_probe = 256 * self.lists.cap * (self.m + 8) + 256 * self.m * 256 * 4
-        g = probe_group_size(nprobe, per_probe)
-        return self._search_blocks(
-            q, k,
-            lambda b: _sharded_ivf_pq_search(
+        adc_k = k * self.refine_k_factor if refining else 0
+        raw = self.raw_lists.data if refining else None
+        with_pallas = self.use_pallas and self._pallas_runtime_ok
+
+        # pair group sized so codes + one-hot transients stay bounded; the
+        # bucket rounding in _routed_search_blocks closes over the same value
+        group = max(8, min(512, (32 << 20) // max(1, self.lists.cap * self.m)))
+
+        def run_routed(block, n, bucket, pallas_on):
+            return _sharded_ivf_pq_search_routed(
+                self.centroids, self.codebooks, self.lists.data,
+                self.lists.ids, self.lists.sizes, block, n, self.mesh, k,
+                nprobe, bucket, group, self.metric, use_pallas=pallas_on,
+                adc_k=adc_k, raw_data=raw,
+            )
+
+        def run_masked(b, pallas_on):
+            per_probe = 256 * self.lists.cap * (self.m + 8) + 256 * self.m * 256 * 4
+            g = probe_group_size(nprobe, per_probe)
+            return _sharded_ivf_pq_search(
                 self.centroids, self.codebooks, self.lists.data, self.lists.ids,
                 self.lists.sizes, b, self.mesh, k, nprobe, g, self.metric,
-            ),
-        )
+                use_pallas=pallas_on, adc_k=adc_k, raw_data=raw,
+            )
+
+        def guarded(call, *args):
+            # same kernel-fault fallback discipline as the unsharded path:
+            # only blame pallas if the XLA path succeeds where it failed
+            nonlocal with_pallas
+            try:
+                out = call(*args, with_pallas)
+                jax.block_until_ready(out)
+                return out
+            except Exception:
+                if not with_pallas:
+                    raise
+                out = call(*args, False)
+                jax.block_until_ready(out)
+                logger.exception(
+                    "pallas ADC kernel failed on this backend; using the XLA "
+                    "path for the rest of this process"
+                )
+                self._pallas_runtime_ok = False
+                with_pallas = False
+                return out
+
+        if self.probe_routing:
+            return _routed_search_blocks(
+                self, q, k, nprobe, group,
+                lambda block, n, bucket: guarded(run_routed, block, n, bucket),
+            )
+        return self._search_blocks(q, k, lambda b: guarded(run_masked, b))
 
     def state_dict(self):
         state = super().state_dict()
         state["kind"] = "sharded_ivf_pq"
         state["probe_routing"] = self.probe_routing
+        if self.refine_k_factor and self._host_raw:
+            if len(self._host_raw) > 1:
+                self._host_raw = [np.concatenate(self._host_raw)]
+            state["refine_rows"] = self._host_raw[0]
         return state
 
     @classmethod
     def from_state_dict(cls, state):
         idx = cls(int(state["dim"]), int(state["nlist"]), m=int(state["m"]),
                   nbits=int(state["nbits"]), metric=str(state["metric"]),
-                  probe_routing=bool(state.get("probe_routing", False)))
+                  probe_routing=bool(state.get("probe_routing", False)),
+                  use_pallas=bool(state.get("use_pallas", False)),
+                  refine_k_factor=int(state.get("refine_k_factor", 0)))
         idx.nprobe = int(state["nprobe"])
         if not bool(state["trained"]):
             return idx
         idx.centroids = jnp.asarray(state["centroids"])
         idx.codebooks = jnp.asarray(state["codebooks"])
-        idx.lists = ShardedPaddedLists(idx.nlist, (idx.m,), np.uint8, idx.mesh)
+        idx.lists = idx._make_lists()  # also builds raw_lists when refining
         rows, assign = state["rows"], state["assign"]
         if rows.shape[0]:
-            idx.lists.append(assign, rows, np.arange(rows.shape[0], dtype=np.int64))
+            gids = np.arange(rows.shape[0], dtype=np.int64)
+            idx.lists.append(assign, rows, gids)
             idx._host_rows = [rows]
             idx._host_assign = [assign]
             idx._n = rows.shape[0]
+            if idx.raw_lists is not None:
+                if "refine_rows" not in state:
+                    raise ValueError(
+                        "sharded IVF-PQ state has refine_k_factor set but no "
+                        "refine_rows payload"
+                    )
+                raw = np.asarray(state["refine_rows"], np.float16)
+                idx.raw_lists.append(assign, raw, gids)
+                idx._host_raw = [raw]
         return idx
 
 
@@ -759,7 +882,8 @@ class ShardedIVFPQIndex(IVFPQIndex):
 
 def _routed_pairs_local(probes, nq_real, nprobe: int, pair_bucket: int,
                         group: int, k: int, cap: int, S: int, anchor,
-                        score_group):
+                        score_group, q=None, raw_local=None, metric=None,
+                        adc_k: int = 0):
     """Shared per-chip body of probe-routed search.
 
     Compacts this chip's owned (query, probe) pairs into ``pair_bucket``,
@@ -767,7 +891,15 @@ def _routed_pairs_local(probes, nq_real, nprobe: int, pair_bucket: int,
     valid) -> (scores (g, cap), ids (g, cap))`` (qi = query row, li = global
     list id, slot = local list slot), reduces to a per-query
     (nq, k) top-k locally, and merges the (S, nq, k) candidate sets over one
-    all_gather. Returns (vals, ids, dropped)."""
+    all_gather. Returns (vals, ids, dropped).
+
+    When ``raw_local`` is given (exact refine), ``score_group`` must return a
+    third (g, cap) array of LOCAL cell positions; the per-query reduction
+    keeps ``adc_k`` candidates, rescans them exactly against ``raw_local``
+    (flattened (slots*cap, d) fp16 rows addressed by position), and only the
+    refined (nq, k) set rides the all_gather."""
+    refine = raw_local is not None
+    local_k = adc_k if refine else k
     nq = probes.shape[0]
     n_pairs = nq * nprobe
     ngroups = pair_bucket // group
@@ -790,27 +922,32 @@ def _routed_pairs_local(probes, nq_real, nprobe: int, pair_bucket: int,
     pair_li = flat_li[sel_idx]                         # (B,)
     pair_slot = jnp.where(pair_valid, pair_li // S, 0)
 
-    kk = min(k, cap)
+    kk = min(local_k, cap)
 
     def body(carry, g_idx):
-        vals_acc, ids_acc = carry
+        vals_acc, ids_acc, pos_acc = carry
         s0 = g_idx * group
         qi = jax.lax.dynamic_slice(pair_qi, (s0,), (group,))
         li = jax.lax.dynamic_slice(pair_li, (s0,), (group,))
         slot = jax.lax.dynamic_slice(pair_slot, (s0,), (group,))
         valid = jax.lax.dynamic_slice(pair_valid, (s0,), (group,))
-        s, ids = score_group(qi, li, slot, valid)      # (g, cap) each
+        out = score_group(qi, li, slot, valid)         # (g, cap) each
+        s, ids = out[0], out[1]
         pv, pp = jax.lax.top_k(s, kk)                  # per-pair top-k
         pids = jnp.take_along_axis(ids, pp, axis=1)
         vals_acc = jax.lax.dynamic_update_slice(vals_acc, pv, (s0, 0))
         ids_acc = jax.lax.dynamic_update_slice(ids_acc, pids, (s0, 0))
-        return (vals_acc, ids_acc), None
+        if refine:
+            ppos = jnp.take_along_axis(out[2], pp, axis=1)
+            pos_acc = jax.lax.dynamic_update_slice(pos_acc, ppos, (s0, 0))
+        return (vals_acc, ids_acc, pos_acc), None
 
     init = (
         jnp.full((pair_bucket, kk), distance.NEG_INF, jnp.float32) + anchor,
         jnp.full((pair_bucket, kk), -1, jnp.int32) + anchor.astype(jnp.int32),
+        jnp.full((pair_bucket, kk), -1, jnp.int32) + anchor.astype(jnp.int32),
     )
-    (pair_vals, pair_ids), _ = jax.lax.scan(
+    (pair_vals, pair_ids, pair_pos), _ = jax.lax.scan(
         body, init, jnp.arange(ngroups, dtype=jnp.int32)
     )
 
@@ -822,25 +959,40 @@ def _routed_pairs_local(probes, nq_real, nprobe: int, pair_bucket: int,
     nqb = -(-nq // QB)
 
     def qmerge(carry, b_idx):
-        out_v, out_i = carry
+        out_v, out_i, out_p = carry
         q0 = b_idx * QB
         qids = q0 + jnp.arange(QB, dtype=jnp.int32)   # (QB,)
         m = pair_qi[None, :] == qids[:, None]         # (QB, B)
         mv = jnp.where(m[:, :, None], pair_vals[None, :, :], distance.NEG_INF)
         mi = jnp.where(m[:, :, None], pair_ids[None, :, :], -1)
-        bv, bp = jax.lax.top_k(mv.reshape(QB, -1), k)
+        bv, bp = jax.lax.top_k(mv.reshape(QB, -1), local_k)
         bi = jnp.take_along_axis(mi.reshape(QB, -1), bp, axis=1)
         out_v = jax.lax.dynamic_update_slice(out_v, bv, (q0, 0))
         out_i = jax.lax.dynamic_update_slice(out_i, bi, (q0, 0))
-        return (out_v, out_i), None
+        if refine:
+            mp = jnp.where(m[:, :, None], pair_pos[None, :, :], -1)
+            bpos = jnp.take_along_axis(mp.reshape(QB, -1), bp, axis=1)
+            out_p = jax.lax.dynamic_update_slice(out_p, bpos, (q0, 0))
+        return (out_v, out_i, out_p), None
 
     pad_q = nqb * QB
     init_q = (
-        jnp.full((pad_q, k), distance.NEG_INF, jnp.float32) + anchor,
-        jnp.full((pad_q, k), -1, jnp.int32) + anchor.astype(jnp.int32),
+        jnp.full((pad_q, local_k), distance.NEG_INF, jnp.float32) + anchor,
+        jnp.full((pad_q, local_k), -1, jnp.int32) + anchor.astype(jnp.int32),
+        jnp.full((pad_q, local_k), -1, jnp.int32) + anchor.astype(jnp.int32),
     )
-    (loc_v, loc_i), _ = jax.lax.scan(qmerge, init_q, jnp.arange(nqb, dtype=jnp.int32))
+    (loc_v, loc_i, loc_p), _ = jax.lax.scan(qmerge, init_q,
+                                            jnp.arange(nqb, dtype=jnp.int32))
     loc_v, loc_i = loc_v[:nq], loc_i[:nq]
+    if refine:
+        # exact rescan of this chip's adc_k shortlist before the merge
+        loc_p = loc_p[:nq]
+        safe = jnp.where(loc_p >= 0, loc_p, 0)
+        rows = raw_local.reshape(-1, raw_local.shape[-1])[safe]
+        s = ivfmod.exact_candidate_scores(q, rows, metric)
+        s = jnp.where(loc_p >= 0, s, distance.NEG_INF)
+        loc_v, best = jax.lax.top_k(s, k)
+        loc_i = jnp.take_along_axis(loc_i, best, axis=1)
     av = jax.lax.all_gather(loc_v, AXIS)              # (S, nq, k)
     ai = jax.lax.all_gather(loc_i, AXIS)
     fv = jnp.transpose(av, (1, 0, 2)).reshape(nq, -1)
@@ -909,13 +1061,17 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "pair_bucket",
-                                             "group", "metric"))
+                                             "group", "metric", "use_pallas",
+                                             "adc_k"))
 def _sharded_ivf_pq_search_routed(centroids, codebooks, list_codes, list_ids,
                                   list_sizes, q, nq_real, mesh, k: int,
                                   nprobe: int, pair_bucket: int, group: int,
-                                  metric: str):
-    """Probe-routed sharded IVF-PQ: per-pair residual LUTs + one-hot ADC over
-    owned pairs only (same scaffold as the flat variant)."""
+                                  metric: str, use_pallas: bool = False,
+                                  adc_k: int = 0, raw_data=None):
+    """Probe-routed sharded IVF-PQ: per-pair residual LUTs + ADC (one-hot
+    einsum or fused pallas kernel) over owned pairs only (same scaffold as
+    the flat variant). adc_k/raw_data enable pre-merge exact refine — see
+    _routed_pairs_local."""
     from distributed_faiss_tpu.ops import pq as pqops
 
     q = q.astype(jnp.float32)
@@ -924,8 +1080,9 @@ def _sharded_ivf_pq_search_routed(centroids, codebooks, list_codes, list_ids,
     cap = list_codes.shape[1]
     S = mesh.shape[AXIS]
     m, ksub, _ = codebooks.shape
+    refine = raw_data is not None
 
-    def local(q, probes, nq_real, codes_local, ids_local, sizes_local):
+    def local(q, probes, nq_real, codes_local, ids_local, sizes_local, raw_local):
         anchor = jnp.where(jnp.zeros((), bool),
                            codes_local.reshape(-1)[0].astype(jnp.float32), 0.0)
 
@@ -937,21 +1094,44 @@ def _sharded_ivf_pq_search_routed(centroids, codebooks, list_codes, list_ids,
                 r = qv
             lut = pqops.adc_lut(r, codebooks, metric=metric)  # (g, m, ksub)
             codes = codes_local[slot]                    # (g, cap, m)
-            iota = jnp.arange(ksub, dtype=jnp.int32)
-            onehot = (codes[..., None].astype(jnp.int32) == iota).astype(jnp.float32)
-            s = jnp.einsum("gmj,gcmj->gc", lut, onehot, precision=_HIGHEST,
-                           preferred_element_type=jnp.float32)
+            if use_pallas:
+                from distributed_faiss_tpu.ops import adc_pallas
+
+                s = adc_pallas.adc_scan_auto(lut, codes)  # (g, cap)
+            else:
+                iota = jnp.arange(ksub, dtype=jnp.int32)
+                onehot = (codes[..., None].astype(jnp.int32) == iota).astype(jnp.float32)
+                s = jnp.einsum("gmj,gcmj->gc", lut, onehot, precision=_HIGHEST,
+                               preferred_element_type=jnp.float32)
             ids = ids_local[slot]
             sizes = sizes_local[slot]
             ok = (jnp.arange(cap)[None, :] < sizes[:, None]) & (ids >= 0)
             ok = ok & valid[:, None]
-            return jnp.where(ok, s, distance.NEG_INF), jnp.where(ok, ids, -1)
+            s = jnp.where(ok, s, distance.NEG_INF)
+            ids = jnp.where(ok, ids, -1)
+            if not refine:
+                return s, ids
+            pos = slot[:, None] * cap + jnp.arange(cap, dtype=jnp.int32)[None, :]
+            return s, ids, jnp.where(ok, pos, -1)
 
         return _routed_pairs_local(probes, nq_real, nprobe, pair_bucket, group,
-                                   k, cap, S, anchor, score_group)
+                                   k, cap, S, anchor, score_group,
+                                   q=q, raw_local=raw_local, metric=metric,
+                                   adc_k=adc_k)
 
+    if refine:
+        fn = _shard_map_fn(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS),
+                      P(AXIS, None, None)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        return fn(q, probes, jnp.asarray(nq_real, jnp.int32),
+                  list_codes, list_ids, list_sizes, raw_data)
     fn = _shard_map_fn(
-        local,
+        lambda a, b, c, d, e, f: local(a, b, c, d, e, f, None),
         mesh=mesh,
         in_specs=(P(), P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS)),
         out_specs=(P(), P(), P()),
@@ -965,25 +1145,46 @@ def _routed_search_blocks(index, q, k: int, nprobe: int, group: int, call):
     """Shared block-loop driver for probe-routed searches.
 
     ``call(block, nq_real, bucket) -> (vals, ids, dropped)``. Handles query
-    bucketing, the dropped-pairs warning, and FAISS-style finalization."""
-    import logging
+    bucketing, drop-driven bucket resizing, and FAISS-style finalization.
 
+    Dropped pairs are silently-unscanned candidates (= recall loss), so a
+    nonzero drop count is never just warned about: the block re-runs with a
+    doubled bucket until drops reach zero or the bucket covers every pair
+    (at which point drops are impossible). The grown slack persists on the
+    index so later blocks — and later searches — start at the size that
+    worked; each growth step is one extra compile, paid at most
+    log2(S / slack) times per (shape, nprobe)."""
     S = index.mesh.shape[AXIS]
     q = np.asarray(q, np.float32)
     nq = q.shape[0]
     out_s = np.empty((nq, k), np.float32)
     out_i = np.empty((nq, k), np.int64)
+    slack = float(getattr(index, "_routed_slack", 2.0))
     for s0, n, block in base.query_blocks(q):
-        bucket = routed_pair_bucket(block.shape[0], nprobe, S, group)
-        vals, ids, dropped = call(jnp.asarray(block), n, bucket)
-        nd = int(dropped)
-        if nd:
-            logging.getLogger().warning(
-                "probe routing dropped %d pairs on the busiest chip (skewed "
-                "list ownership); raise the slack or disable probe_routing", nd,
+        bq = block.shape[0]
+        # every pair on one chip is the worst case: a bucket this big
+        # cannot drop, so the resize loop below terminates
+        hard_cap = -(-bq * nprobe // group) * group
+        bucket = min(routed_pair_bucket(bq, nprobe, S, group, slack), hard_cap)
+        while True:
+            vals, ids, dropped = call(jnp.asarray(block), n, bucket)
+            nd = int(dropped)
+            if nd == 0 or bucket >= hard_cap:
+                break
+            bucket = min(2 * bucket, hard_cap)
+            slack = min(2.0 * slack, float(S))
+            logger.info(
+                "probe routing dropped %d pairs (skewed list ownership); "
+                "retrying block with bucket=%d", nd, bucket,
+            )
+        if nd:  # pragma: no cover - unreachable once bucket == hard_cap
+            logger.warning(
+                "probe routing still dropped %d pairs at the full-pair "
+                "bucket; results may lose recall", nd,
             )
         out_s[s0:s0 + n] = np.asarray(vals)[:n]
         out_i[s0:s0 + n] = np.asarray(ids)[:n]
+    index._routed_slack = slack
     return base.finalize_results(out_s, out_i, index.metric)
 
 

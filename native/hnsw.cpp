@@ -11,21 +11,38 @@
 // on the fly (d = sum_i (q_i - (vmin_i + c_i * step_i))^2, L2 only — the
 // reference asserts L2 for hnswsq too).
 //
-// C API at the bottom (ctypes-consumed by models/hnsw.py).
+// Concurrency model (mirrors the discipline of FAISS's OpenMP HNSW, which
+// the reference gets for free):
+//   - Adjacency lists are FIXED-CAPACITY arrays of std::atomic<int> with an
+//     atomic count. Readers take no locks: acquire-load the count, read the
+//     prefix. Writers mutate only under a striped per-node mutex and publish
+//     with a release-store of the count, so a reader never sees a torn or
+//     out-of-bounds neighbor. (This is why capacities are fixed: a growable
+//     vector would invalidate concurrent readers on realloc.)
+//   - add_batch() appends codes/levels/link-frames sequentially (cheap),
+//     then builds the graph links for the batch on a thread pool. Only one
+//     stripe lock is ever held at a time -> no deadlock.
+//   - search() is lock-free w.r.t. the graph and uses a pooled per-call
+//     visited table, so concurrent searches on ONE graph are safe; batched
+//     queries also fan out over the thread pool.
+//   - The one remaining exclusion the CALLER must provide: add_batch() must
+//     not overlap search()/save() (codes_/levels_ vectors grow). The engine's
+//     index_lock already provides this in the serving path.
 //
-// Thread-safety: search() reuses a shared visited-epoch scratch, so
-// concurrent searches on ONE graph are NOT safe; the serving engine already
-// serializes per-index device/search calls via its index_lock (the same
-// discipline the reference applies to FAISS, index.py:246-252). Distinct
-// HNSW instances are independent.
+// C API at the bottom (ctypes-consumed by models/hnsw.py).
 
 #include <algorithm>
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <memory>
+#include <mutex>
 #include <queue>
 #include <random>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -41,11 +58,114 @@ struct FarCmp {  // max-heap by distance
     bool operator()(const Neighbor& a, const Neighbor& b) const { return a.dist < b.dist; }
 };
 
+// Fixed-capacity adjacency list readable without locks (see module comment).
+struct Links {
+    std::unique_ptr<std::atomic<int>[]> ids;
+    std::atomic<int> count{0};
+    int cap = 0;
+
+    void init(int c) {
+        cap = c;
+        ids.reset(new std::atomic<int>[c]);
+    }
+    // snapshot the stable prefix into out
+    void read(std::vector<int>* out) const {
+        int c = count.load(std::memory_order_acquire);
+        out->resize(c);
+        for (int i = 0; i < c; ++i) (*out)[i] = ids[i].load(std::memory_order_relaxed);
+    }
+    // writer-side (caller holds the node's stripe lock)
+    void rewrite(const std::vector<Neighbor>& v) {
+        count.store(0, std::memory_order_release);
+        int c = std::min<int>(cap, v.size());
+        for (int i = 0; i < c; ++i) ids[i].store(v[i].id, std::memory_order_relaxed);
+        count.store(c, std::memory_order_release);
+    }
+    bool append(int id) {  // false when full
+        int c = count.load(std::memory_order_relaxed);
+        if (c >= cap) return false;
+        ids[c].store(id, std::memory_order_relaxed);
+        count.store(c + 1, std::memory_order_release);
+        return true;
+    }
+};
+
+// reusable visited-epoch scratch; pooled so concurrent searches never share
+struct Visited {
+    std::vector<uint32_t> v;
+    uint32_t epoch = 0;
+
+    void begin(size_t n) {
+        if (v.size() < n) v.resize(n, 0u);
+        if (++epoch == 0) {
+            std::fill(v.begin(), v.end(), 0u);
+            epoch = 1;
+        }
+    }
+    bool test_set(int i) {
+        if (v[i] == epoch) return true;
+        v[i] = epoch;
+        return false;
+    }
+};
+
+class VisitedPool {
+  public:
+    std::unique_ptr<Visited> get() {
+        std::lock_guard<std::mutex> g(mu_);
+        if (free_.empty()) return std::unique_ptr<Visited>(new Visited());
+        auto out = std::move(free_.back());
+        free_.pop_back();
+        return out;
+    }
+    void put(std::unique_ptr<Visited> v) {
+        std::lock_guard<std::mutex> g(mu_);
+        free_.push_back(std::move(v));
+    }
+
+  private:
+    std::mutex mu_;
+    std::vector<std::unique_ptr<Visited>> free_;
+};
+
+int default_threads() {
+    if (const char* e = std::getenv("DFT_HNSW_THREADS")) {
+        int v = std::atoi(e);
+        if (v > 0) return v;
+    }
+    unsigned hc = std::thread::hardware_concurrency();
+    return hc ? static_cast<int>(hc) : 1;
+}
+
+// run fn(i) for i in [0, n) on up to nthreads workers
+template <typename F>
+void parallel_for(int n, int nthreads, F fn) {
+    nthreads = std::min(nthreads, n);
+    if (nthreads <= 1) {
+        for (int i = 0; i < n; ++i) fn(i);
+        return;
+    }
+    std::atomic<int> next{0};
+    std::vector<std::thread> ts;
+    ts.reserve(nthreads);
+    for (int t = 0; t < nthreads; ++t) {
+        ts.emplace_back([&] {
+            for (;;) {
+                int i = next.fetch_add(1, std::memory_order_relaxed);
+                if (i >= n) return;
+                fn(i);
+            }
+        });
+    }
+    for (auto& t : ts) t.join();
+}
+
 class HNSW {
   public:
     HNSW(int dim, int M, int ef_construction, unsigned seed)
         : dim_(dim), M_(M), M0_(2 * M), efc_(ef_construction), rng_(seed),
-          ml_(1.0f / std::log(static_cast<float>(M))), entry_(-1), max_level_(-1) {
+          ml_(1.0f / std::log(static_cast<float>(M))), entry_(-1), max_level_(-1),
+          nthreads_(default_threads()) {
         vmin_.assign(dim, 0.f);
         step_.assign(dim, 1.f / 255.f);
     }
@@ -55,39 +175,75 @@ class HNSW {
         std::copy(step, step + dim_, step_.begin());
     }
 
+    void set_threads(int n) { nthreads_ = n > 0 ? n : default_threads(); }
+
     int size() const { return static_cast<int>(levels_.size()); }
 
     void add_batch(int n, const uint8_t* codes) {
-        for (int i = 0; i < n; ++i) insert(codes + static_cast<size_t>(i) * dim_);
+        if (n <= 0) return;
+        int base = size();
+        // sequential prep: codes, deterministic levels, link frames. After
+        // this the per-node Links objects are stable for the parallel phase.
+        codes_.insert(codes_.end(), codes, codes + static_cast<size_t>(n) * dim_);
+        std::uniform_real_distribution<float> uni(1e-9f, 1.0f);
+        for (int i = 0; i < n; ++i) {
+            int level = static_cast<int>(-std::log(uni(rng_)) * ml_);
+            levels_.push_back(level);
+            auto l0 = std::unique_ptr<Links>(new Links());
+            l0->init(M0_);
+            links0_.push_back(std::move(l0));
+            auto up = std::unique_ptr<std::vector<Links>>(new std::vector<Links>(
+                level > 0 ? level : 0));
+            for (auto& l : *up) l.init(M_);
+            upper_.push_back(std::move(up));
+        }
+        int start = base;
+        if (entry_.load(std::memory_order_acquire) < 0) {
+            // bootstrap the graph with one synchronous insert
+            link_node(base);
+            start = base + 1;
+        }
+        int todo = base + n - start;
+        if (todo > 0) {
+            parallel_for(todo, nthreads_, [&](int i) { link_node(start + i); });
+        }
     }
 
     void search(int nq, const float* q, int k, int ef,
                 float* out_d, int64_t* out_i) const {
-        for (int i = 0; i < nq; ++i) {
+        parallel_for(nq, nthreads_, [&](int i) {
             search_one(q + static_cast<size_t>(i) * dim_, k, ef,
                        out_d + static_cast<size_t>(i) * k,
                        out_i + static_cast<size_t>(i) * k);
-        }
+        });
     }
 
     bool save(const char* path) const;
     static HNSW* load(const char* path);
 
   private:
+    static constexpr int kStripes = 1024;
+
     int dim_, M_, M0_, efc_;
     std::mt19937 rng_;
     float ml_;
-    int entry_, max_level_;
+    std::atomic<int> entry_, max_level_;
+    int nthreads_;
     std::vector<float> vmin_, step_;
-    std::vector<uint8_t> codes_;           // n * dim
-    std::vector<int> levels_;              // per node
-    std::vector<std::vector<int>> links0_; // layer-0 adjacency per node
-    // upper layers: upper_[node] has (level) adjacency lists, 1-indexed by
-    // layer (upper_[v][l-1] = neighbors of v at layer l); only nodes with
-    // level >= 1 have entries
-    std::vector<std::vector<std::vector<int>>> upper_;
-    mutable std::vector<uint32_t> visited_;
-    mutable uint32_t epoch_ = 0;
+
+    // a plain vector is fine for code storage because add/search never
+    // overlap (caller contract) — within one add_batch the vector is fully
+    // grown before the parallel phase reads it
+    std::vector<uint8_t> codes_;  // n * dim
+
+    std::vector<int> levels_;                                   // per node
+    std::vector<std::unique_ptr<Links>> links0_;                // layer 0
+    std::vector<std::unique_ptr<std::vector<Links>>> upper_;    // layers >= 1
+    mutable std::mutex stripes_[kStripes];
+    std::mutex entry_mu_;
+    mutable VisitedPool visited_pool_;
+
+    std::mutex& stripe(int v) const { return stripes_[v & (kStripes - 1)]; }
 
     float dist(const float* q, int b) const {
         const uint8_t* c = codes_.data() + static_cast<size_t>(b) * dim_;
@@ -105,37 +261,34 @@ class HNSW {
         for (int i = 0; i < dim_; ++i) out[i] = vmin_[i] + c[i] * step_[i];
     }
 
-    const std::vector<int>& neighbors(int v, int level) const {
-        return level == 0 ? links0_[v] : upper_[v][level - 1];
+    const Links& links(int v, int level) const {
+        return level == 0 ? *links0_[v] : (*upper_[v])[level - 1];
     }
-    std::vector<int>& neighbors(int v, int level) {
-        return level == 0 ? links0_[v] : upper_[v][level - 1];
+    Links& links(int v, int level) {
+        return level == 0 ? *links0_[v] : (*upper_[v])[level - 1];
     }
 
     // best-first search at one layer; returns up to ef closest as a sorted
-    // (ascending) vector
+    // (ascending) vector. Lock-free graph reads (see Links).
     std::vector<Neighbor> search_layer(const float* q, int entry, float entry_d,
-                                       int ef, int level) const {
-        if (++epoch_ == 0) {  // wrapped: clear and restart
-            std::fill(visited_.begin(), visited_.end(), 0u);
-            epoch_ = 1;
-        }
-        if (visited_.size() < levels_.size()) visited_.resize(levels_.size(), 0u);
+                                       int ef, int level, Visited& vis,
+                                       std::vector<int>& nbuf) const {
+        vis.begin(levels_.size());
 
         std::priority_queue<Neighbor, std::vector<Neighbor>, NearCmp> cand;
         std::priority_queue<Neighbor, std::vector<Neighbor>, FarCmp> result;
         cand.push({entry_d, entry});
         result.push({entry_d, entry});
-        visited_[entry] = epoch_;
+        vis.test_set(entry);
 
         while (!cand.empty()) {
             Neighbor cur = cand.top();
             if (cur.dist > result.top().dist && static_cast<int>(result.size()) >= ef)
                 break;
             cand.pop();
-            for (int nb : neighbors(cur.id, level)) {
-                if (visited_[nb] == epoch_) continue;
-                visited_[nb] = epoch_;
+            links(cur.id, level).read(&nbuf);
+            for (int nb : nbuf) {
+                if (vis.test_set(nb)) continue;
                 float d = dist(q, nb);
                 if (static_cast<int>(result.size()) < ef || d < result.top().dist) {
                     cand.push({d, nb});
@@ -153,14 +306,15 @@ class HNSW {
     }
 
     int greedy_descend(const float* q, int from_level, int to_level,
-                       int entry, float* d_io) const {
+                       int entry, float* d_io, std::vector<int>& nbuf) const {
         int cur = entry;
         float cur_d = *d_io;
         for (int l = from_level; l > to_level; --l) {
             bool improved = true;
             while (improved) {
                 improved = false;
-                for (int nb : neighbors(cur, l)) {
+                links(cur, l).read(&nbuf);
+                for (int nb : nbuf) {
                     float d = dist(q, nb);
                     if (d < cur_d) {
                         cur_d = d;
@@ -175,55 +329,73 @@ class HNSW {
     }
 
     // closest-first pruning to cap (simple variant of the paper's heuristic)
-    void prune(std::vector<Neighbor>& cands, int cap) const {
-        std::sort(cands.begin(), cands.end(),
+    static void prune(std::vector<Neighbor>* cands, int cap) {
+        std::sort(cands->begin(), cands->end(),
                   [](const Neighbor& a, const Neighbor& b) { return a.dist < b.dist; });
-        if (static_cast<int>(cands.size()) > cap) cands.resize(cap);
+        if (static_cast<int>(cands->size()) > cap) cands->resize(cap);
     }
 
-    void insert(const uint8_t* code) {
-        int id = size();
-        codes_.insert(codes_.end(), code, code + dim_);
-        std::uniform_real_distribution<float> uni(1e-9f, 1.0f);
-        int level = static_cast<int>(-std::log(uni(rng_)) * ml_);
-        levels_.push_back(level);
-        links0_.emplace_back();
-        upper_.emplace_back();
-        upper_.back().resize(level > 0 ? level : 0);
-
+    // build the graph links of one already-appended node (thread-safe)
+    void link_node(int id) {
+        int level = levels_[id];
         std::vector<float> qf(dim_);
         decode(id, qf.data());
         const float* q = qf.data();
 
-        if (entry_ < 0) {
-            entry_ = id;
-            max_level_ = level;
-            return;
+        int entry = entry_.load(std::memory_order_acquire);
+        if (entry < 0) {
+            std::lock_guard<std::mutex> g(entry_mu_);
+            if (entry_.load(std::memory_order_relaxed) < 0) {
+                entry_.store(id, std::memory_order_release);
+                max_level_.store(level, std::memory_order_release);
+                return;
+            }
+            entry = entry_.load(std::memory_order_relaxed);
         }
+        // entry_ and max_level_ are separate atomics: a concurrent max-level
+        // bump can hand us (old entry, new top). Clamp the descent start to
+        // the entry node's own level so links(entry, l) never goes OOB.
+        int top = std::min(max_level_.load(std::memory_order_acquire), levels_[entry]);
 
-        float d = dist(q, entry_);
-        int cur = greedy_descend(q, max_level_, std::min(level, max_level_), entry_, &d);
+        auto vis = visited_pool_.get();
+        std::vector<int> nbuf;
+        nbuf.reserve(M0_);
 
-        for (int l = std::min(level, max_level_); l >= 0; --l) {
-            auto found = search_layer(q, cur, d, efc_, l);
+        float d = dist(q, entry);
+        int cur = greedy_descend(q, top, std::min(level, top), entry, &d, nbuf);
+
+        std::vector<float> nbf(dim_);
+        std::vector<Neighbor> rel;
+        for (int l = std::min(level, top); l >= 0; --l) {
+            auto found = search_layer(q, cur, d, efc_, l, *vis, nbuf);
             int cap = (l == 0) ? M0_ : M_;
             std::vector<Neighbor> sel(found);
-            prune(sel, M_);
-            auto& my = neighbors(id, l);
+            prune(&sel, M_);
+            {
+                // own links: append under our stripe (backlinking threads
+                // may already be touching this node)
+                std::lock_guard<std::mutex> g(stripe(id));
+                Links& my = links(id, l);
+                for (const auto& nb : sel) {
+                    if (!my.append(nb.id)) break;  // full: keep closest-first set
+                }
+            }
             for (const auto& nb : sel) {
-                my.push_back(nb.id);
-                auto& theirs = neighbors(nb.id, l);
-                theirs.push_back(id);
-                if (static_cast<int>(theirs.size()) > cap) {
-                    // re-rank their links from their own viewpoint
-                    std::vector<float> nbf(dim_);
+                std::lock_guard<std::mutex> g(stripe(nb.id));
+                Links& theirs = links(nb.id, l);
+                if (!theirs.append(id)) {
+                    // full: re-rank their links from their own viewpoint
                     decode(nb.id, nbf.data());
-                    std::vector<Neighbor> rel;
-                    rel.reserve(theirs.size());
-                    for (int t : theirs) rel.push_back({dist(nbf.data(), t), t});
-                    prune(rel, cap);
-                    theirs.clear();
-                    for (const auto& r : rel) theirs.push_back(r.id);
+                    rel.clear();
+                    int c = theirs.count.load(std::memory_order_relaxed);
+                    rel.reserve(c + 1);
+                    for (int i = 0; i < c; ++i) {
+                        int t = theirs.ids[i].load(std::memory_order_relaxed);
+                        rel.push_back({dist(nbf.data(), t), t});
+                    }
+                    rel.push_back({dist(nbf.data(), id), id});
+                    prune(&rel, cap);
+                    theirs.rewrite(rel);
                 }
             }
             if (!found.empty()) {
@@ -231,23 +403,33 @@ class HNSW {
                 d = found[0].dist;
             }
         }
-        if (level > max_level_) {
-            max_level_ = level;
-            entry_ = id;
+        if (level > max_level_.load(std::memory_order_acquire)) {
+            std::lock_guard<std::mutex> g(entry_mu_);
+            if (level > max_level_.load(std::memory_order_relaxed)) {
+                max_level_.store(level, std::memory_order_release);
+                entry_.store(id, std::memory_order_release);
+            }
         }
+        visited_pool_.put(std::move(vis));
     }
 
     void search_one(const float* q, int k, int ef, float* out_d, int64_t* out_i) const {
-        if (entry_ < 0) {
+        int entry = entry_.load(std::memory_order_acquire);
+        if (entry < 0) {
             for (int i = 0; i < k; ++i) {
                 out_d[i] = HUGE_VALF;
                 out_i[i] = -1;
             }
             return;
         }
-        float d = dist(q, entry_);
-        int cur = greedy_descend(q, max_level_, 0, entry_, &d);
-        auto found = search_layer(q, cur, d, std::max(ef, k), 0);
+        auto vis = visited_pool_.get();
+        std::vector<int> nbuf;
+        nbuf.reserve(M0_);
+        float d = dist(q, entry);
+        // clamp as in link_node: (entry, max_level) is not one atomic pair
+        int top = std::min(max_level_.load(std::memory_order_acquire), levels_[entry]);
+        int cur = greedy_descend(q, top, 0, entry, &d, nbuf);
+        auto found = search_layer(q, cur, d, std::max(ef, k), 0, *vis, nbuf);
         int n = std::min<int>(k, found.size());
         for (int i = 0; i < n; ++i) {
             out_d[i] = found[i].dist;
@@ -257,26 +439,37 @@ class HNSW {
             out_d[i] = HUGE_VALF;
             out_i[i] = -1;
         }
+        visited_pool_.put(std::move(vis));
     }
 };
 
 // ---------------------------------------------------------------- serialization
+// On-disk format is unchanged from the pre-parallel engine (vectors of int),
+// so graphs saved by older builds load fine.
 
 template <typename T>
 void wr(FILE* f, const T& v) { std::fwrite(&v, sizeof(T), 1, f); }
 template <typename T>
 bool rd(FILE* f, T* v) { return std::fread(v, sizeof(T), 1, f) == 1; }
 
-void wr_vec_i(FILE* f, const std::vector<int>& v) {
+void wr_links(FILE* f, const Links& l) {
+    std::vector<int> v;
+    l.read(&v);
     int64_t n = v.size();
     wr(f, n);
     if (n) std::fwrite(v.data(), sizeof(int), n, f);
 }
-bool rd_vec_i(FILE* f, std::vector<int>* v) {
+bool rd_links(FILE* f, Links* l, int cap) {
     int64_t n;
     if (!rd(f, &n)) return false;
-    v->resize(n);
-    return n == 0 || std::fread(v->data(), sizeof(int), n, f) == static_cast<size_t>(n);
+    if (n > cap) cap = static_cast<int>(n);  // defensive: never truncate
+    l->init(cap);
+    std::vector<int> v(n);
+    if (n && std::fread(v.data(), sizeof(int), n, f) != static_cast<size_t>(n))
+        return false;
+    for (int64_t i = 0; i < n; ++i) l->ids[i].store(v[i], std::memory_order_relaxed);
+    l->count.store(static_cast<int>(n), std::memory_order_release);
+    return true;
 }
 
 bool HNSW::save(const char* path) const {
@@ -285,7 +478,9 @@ bool HNSW::save(const char* path) const {
     const uint32_t magic = 0x44465448;  // "DFTH"
     wr(f, magic);
     wr(f, dim_); wr(f, M_); wr(f, M0_); wr(f, efc_);
-    wr(f, entry_); wr(f, max_level_); wr(f, ml_);
+    int entry = entry_.load(std::memory_order_acquire);
+    int max_level = max_level_.load(std::memory_order_acquire);
+    wr(f, entry); wr(f, max_level); wr(f, ml_);
     int64_t n = size();
     wr(f, n);
     std::fwrite(vmin_.data(), sizeof(float), dim_, f);
@@ -295,10 +490,10 @@ bool HNSW::save(const char* path) const {
         std::fwrite(levels_.data(), sizeof(int), n, f);
     }
     for (int64_t i = 0; i < n; ++i) {
-        wr_vec_i(f, links0_[i]);
-        int32_t nl = upper_[i].size();
+        wr_links(f, *links0_[i]);
+        int32_t nl = upper_[i]->size();
         wr(f, nl);
-        for (const auto& lv : upper_[i]) wr_vec_i(f, lv);
+        for (const auto& lv : *upper_[i]) wr_links(f, lv);
     }
     std::fclose(f);
     return true;
@@ -319,8 +514,8 @@ HNSW* HNSW::load(const char* path) {
     }
     HNSW* h = new HNSW(dim, M, efc, 0);
     h->M0_ = M0;
-    h->entry_ = entry;
-    h->max_level_ = max_level;
+    h->entry_.store(entry, std::memory_order_release);
+    h->max_level_.store(max_level, std::memory_order_release);
     h->ml_ = ml;
     bool ok = std::fread(h->vmin_.data(), sizeof(float), dim, f) == static_cast<size_t>(dim)
            && std::fread(h->step_.data(), sizeof(float), dim, f) == static_cast<size_t>(dim);
@@ -333,12 +528,14 @@ HNSW* HNSW::load(const char* path) {
     h->links0_.resize(n);
     h->upper_.resize(n);
     for (int64_t i = 0; ok && i < n; ++i) {
-        ok = rd_vec_i(f, &h->links0_[i]);
+        h->links0_[i].reset(new Links());
+        ok = rd_links(f, h->links0_[i].get(), M0);
         int32_t nl = 0;
         ok = ok && rd(f, &nl);
         if (ok) {
-            h->upper_[i].resize(nl);
-            for (int32_t l = 0; ok && l < nl; ++l) ok = rd_vec_i(f, &h->upper_[i][l]);
+            h->upper_[i].reset(new std::vector<Links>(nl));
+            for (int32_t l = 0; ok && l < nl; ++l)
+                ok = rd_links(f, &(*h->upper_[i])[l], M);
         }
     }
     std::fclose(f);
@@ -362,6 +559,7 @@ void dft_hnsw_free(void* h) { delete static_cast<HNSW*>(h); }
 void dft_hnsw_set_codec(void* h, const float* vmin, const float* step) {
     static_cast<HNSW*>(h)->set_codec(vmin, step);
 }
+void dft_hnsw_set_threads(void* h, int n) { static_cast<HNSW*>(h)->set_threads(n); }
 void dft_hnsw_add(void* h, int n, const uint8_t* codes) {
     static_cast<HNSW*>(h)->add_batch(n, codes);
 }

@@ -99,3 +99,52 @@ def test_engine_integration(rng):
         time.sleep(0.05)
     D, M, _ = idx.search(x[:4], 5)
     assert sum(M[i][0] == ("d", i) for i in range(4)) >= 3
+
+
+def test_threaded_build_recall(rng):
+    """Graph built with 8 forced construction threads (max lock contention on
+    any core count) must reach the same recall grade as a serial build."""
+    if not hnsw.native_available():
+        pytest.skip("no native toolchain")
+    n, d = 8000, 32
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((50, d)).astype(np.float32)
+    idx = hnsw.HNSWSQIndex(d, "l2", M=16, ef_construction=80)
+    idx.set_threads(8)
+    idx.train(x[:2000])
+    for s in range(0, n, 2000):  # several batches -> several parallel phases
+        idx.add(x[s:s + 2000])
+    assert idx.ntotal == n
+    d2 = (q ** 2).sum(1)[:, None] - 2 * q @ x.T + (x ** 2).sum(1)[None, :]
+    gt = np.argsort(d2, axis=1)[:, :10]
+    idx.nprobe = 128
+    _, I = idx.search(q, 10)
+    rec = np.mean([len(set(I[i]) & set(gt[i])) / 10 for i in range(50)])
+    assert rec > 0.8, rec
+
+
+def test_concurrent_searches_consistent(rng):
+    """Concurrent search() calls on ONE instance are safe (pooled visited
+    tables) and agree with the serial answer."""
+    import threading
+
+    if not hnsw.native_available():
+        pytest.skip("no native toolchain")
+    x = rng.standard_normal((3000, 16)).astype(np.float32)
+    q = rng.standard_normal((64, 16)).astype(np.float32)
+    idx = hnsw.HNSWSQIndex(16, "l2", M=16, ef_construction=60)
+    idx.train(x)
+    idx.add(x)
+    idx.nprobe = 64
+    D0, I0 = idx.search(q, 5)
+    outs = [None] * 6
+    def worker(t):
+        outs[t] = idx.search(q, 5)
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for D, I in outs:
+        np.testing.assert_array_equal(I, I0)
+        np.testing.assert_allclose(D, D0, rtol=1e-6)
