@@ -1,0 +1,131 @@
+#!/usr/bin/env python3
+"""Compiled-on-TPU validation of the Pallas ADC kernels.
+
+VERDICT (round 1) flagged that ops/adc_pallas.py had only ever executed via
+the Pallas interpreter (interpret=True); compiled Mosaic behavior (tiling
+constraints, dtype rules) was unproven. This script runs both kernels
+compiled (interpret=False) on the real chip and asserts parity against a
+numpy golden, across the shapes the IVF-PQ path actually emits:
+
+  - shared-list scan at the default TILE=512 with a non-multiple L
+  - per-query scan (the probed-lists path) at m=64 / ksub=256 (the knnlm
+    flagship geometry) and the small smoke geometry
+  - tiny-L edge case (tile clamp path)
+
+Prints one JSON line per case; exits nonzero on any mismatch. Run from the
+repo root (the axon PJRT plugin only registers there).
+
+Results are recorded in benchmarks/RESULTS.md; tests/test_adc_pallas.py
+keeps the interpreter-mode coverage for CPU CI.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def np_adc_shared(lut, codes):
+    nq, L = lut.shape[0], codes.shape[0]
+    out = np.zeros((nq, L), np.float32)
+    for mi in range(codes.shape[1]):
+        out += lut[:, mi, codes[:, mi].astype(np.int64)]
+    return out
+
+
+def np_adc_per_query(lut, codes):
+    nq, L = codes.shape[0], codes.shape[1]
+    out = np.zeros((nq, L), np.float32)
+    for qi in range(nq):
+        out[qi] = np_adc_shared(lut[qi:qi + 1], codes[qi])[0]
+    return out
+
+
+def main():
+    import jax
+
+    from distributed_faiss_tpu.ops import adc_pallas
+
+    platform = jax.devices()[0].platform
+    if platform not in ("tpu", "axon"):
+        print(json.dumps({"error": f"not on TPU (platform={platform})"}))
+        return 1
+
+    rng = np.random.default_rng(0)
+    failures = 0
+
+    cases = [
+        # (name, nq, m, ksub, L, tile, shared)
+        ("shared_default_tile", 64, 16, 256, 5000, 512, True),
+        ("shared_knnlm_geometry", 32, 64, 256, 4096, 512, True),
+        ("shared_tiny_L", 4, 8, 256, 13, 512, True),
+        ("per_query_smoke", 8, 16, 256, 700, 256, False),
+        ("per_query_knnlm", 16, 64, 256, 2048, 512, False),
+    ]
+    for name, nq, m, ksub, L, tile, shared in cases:
+        lut = rng.standard_normal((nq, m, ksub)).astype(np.float32)
+        if shared:
+            codes = rng.integers(0, ksub, (L, m)).astype(np.uint8)
+            t0 = time.time()
+            got = np.asarray(adc_pallas.adc_scan_shared_pallas(
+                lut, codes, tile=tile, interpret=False))
+            dt = time.time() - t0
+            want = np_adc_shared(lut, codes)
+        else:
+            codes = rng.integers(0, ksub, (nq, L, m)).astype(np.uint8)
+            t0 = time.time()
+            got = np.asarray(adc_pallas.adc_scan_pallas(
+                lut, codes, tile=tile, interpret=False))
+            dt = time.time() - t0
+            want = np_adc_per_query(lut, codes)
+        err = float(np.max(np.abs(got - want)))
+        ok = bool(np.allclose(got, want, rtol=1e-4, atol=1e-4))
+        print(json.dumps({
+            "case": name, "nq": nq, "m": m, "L": L, "tile": tile,
+            "compiled": True, "max_abs_err": round(err, 7), "ok": ok,
+            "first_call_s": round(dt, 2),
+        }), flush=True)
+        failures += 0 if ok else 1
+
+    # steady-state throughput of the compiled shared scan at flagship
+    # geometry — device-resident inputs (the serving pattern; numpy args
+    # would re-ride the host relay every call and measure the tunnel).
+    from distributed_faiss_tpu.ops import pq as pq_ops
+
+    nq, m, ksub, L = 32, 64, 256, 65536
+    lut = jax.device_put(rng.standard_normal((nq, m, ksub)).astype(np.float32))
+    codes = jax.device_put(rng.integers(0, ksub, (L, m)).astype(np.uint8))
+    reps = 20
+    import jax.numpy as jnp
+
+    lut_bf16 = jax.device_put(np.asarray(lut)).astype(jnp.bfloat16)
+    for name, fn in (
+        ("pallas_shared_throughput",
+         lambda: adc_pallas.adc_scan_shared_pallas(lut, codes, interpret=False)),
+        ("pallas_shared_bf16_lut_throughput",
+         lambda: adc_pallas.adc_scan_shared_pallas(lut_bf16, codes, interpret=False)),
+        ("xla_onehot_shared_throughput",
+         lambda: pq_ops.adc_scan_shared(lut, codes)),
+    ):
+        fn().block_until_ready()
+        t0 = time.time()
+        for _ in range(reps):
+            out = fn()
+        out.block_until_ready()
+        dt = (time.time() - t0) / reps
+        print(json.dumps({
+            "case": name, "nq": nq, "m": m, "L": L,
+            "ms_per_scan": round(dt * 1e3, 3),
+            "codes_scored_per_s": round(nq * L / dt / 1e6, 1),
+            "unit": "M code-scores/s",
+        }), flush=True)
+    return failures
+
+
+if __name__ == "__main__":
+    os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.exit(main())

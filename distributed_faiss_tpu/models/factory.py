@@ -25,6 +25,7 @@ Builders (same names as the reference):
 """
 
 import logging
+import re
 from typing import Optional
 
 from distributed_faiss_tpu.models.flat import FlatIndex
@@ -85,6 +86,7 @@ def _build_knnlm(cfg: IndexCfg):
             probe_routing=bool(cfg.extra.get("probe_routing")),
             use_pallas=bool(cfg.extra.get("pallas_adc", False)),
             refine_k_factor=int(cfg.extra.get("refine_k_factor", 0)),
+            adc_lut_bf16=bool(cfg.extra.get("adc_lut_bf16", False)),
         )
     if cfg.extra.get("probe_routing"):
         logging.getLogger().warning(
@@ -93,7 +95,8 @@ def _build_knnlm(cfg: IndexCfg):
     return IVFPQIndex(cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
                       kmeans_iters=_kmeans_iters(cfg),
                       use_pallas=bool(cfg.extra.get("pallas_adc", False)),
-                      refine_k_factor=int(cfg.extra.get("refine_k_factor", 0)))
+                      refine_k_factor=int(cfg.extra.get("refine_k_factor", 0)),
+                      adc_lut_bf16=bool(cfg.extra.get("adc_lut_bf16", False)))
 
 
 def _build_ivfsq(cfg: IndexCfg) -> IVFFlatIndex:
@@ -145,13 +148,34 @@ INDEX_BUILDERS = {
 }
 
 
-def parse_factory(cfg: IndexCfg):
-    """Build from a FAISS-style factory spec (subset of the grammar).
+_OPQ_RE = re.compile(r"^OPQ(\d+)(?:_(\d+))?$")
+_PCA_RE = re.compile(r"^PCAR?(\d+)$")
+_HNSW_RE = re.compile(r"^HNSW(\d+)$")
 
-    Supported: "Flat", "SQ8", "SQfp16", "PQ<m>[x8]", "IVF<n>,Flat",
-    "IVF<n>,SQ8", "IVF<n>,SQfp16", "IVF<n>,PQ<m>[x8]".
-    "{centroids}" templating matches the reference (index.py:391-394,
-    scripts/idx_cfg.json uses "IVF{centroids},SQ8").
+
+def parse_factory(cfg: IndexCfg):
+    """Build from a FAISS-style factory spec.
+
+    Grammar (the subset of faiss.index_factory the reference can reach via
+    its cfg files — distributed_faiss/index.py:396 plus
+    scripts/idx_cfg.json's "IVF{centroids},SQ8"):
+
+      [OPQ<m>[_<dout>],|PCA<dout>,|PCAR<dout>,] <core> [,RFlat|,Refine(Flat)]
+      core := Flat | SQ8 | SQfp16 | PQ<m>[x8]
+            | IVF<n>,(Flat|SQ8|SQfp16|PQ<m>[x8])
+            | HNSW<M>[,Flat|,SQ8]
+
+    Notes vs FAISS: PCAR's trailing random rotation is folded into the PCA
+    basis (principal axes are already a rotation; the extra random rotation
+    only matters for balancing PQ subspaces, which OPQ does better); HNSW
+    always stores SQ8 codes (the native graph's storage codec — "HNSW32"
+    and "HNSW32,Flat" get SQ8 storage, documented divergence); RFlat keeps
+    fp16 rows and reranks k*refine_k_factor (cfg.extra, default 8 — FAISS's
+    k_factor default of 1 barely moves recall). RFlat under a DIM-REDUCING
+    pre-transform ("OPQ8_32,...,RFlat" / "PCA32,...,RFlat") reranks in the
+    reduced space — it cannot recover projection error the way FAISS's
+    IndexRefineFlat (full-dim f32 rows) can; a warning is logged. Under a
+    full-dim rotation the rerank is equivalent (rotations preserve l2/ip).
     """
     spec = cfg.faiss_factory
     if "{centroids}" in spec:
@@ -168,34 +192,114 @@ def parse_factory(cfg: IndexCfg):
                 raise RuntimeError(f"only 8-bit PQ supported, got {token}")
         return int(body)
 
-    if len(parts) == 1:
-        p = parts[0]
-        if p == "Flat":
-            return FlatIndex(cfg.dim, metric)
-        if p == "SQ8":
-            return FlatIndex(cfg.dim, metric, codec="sq8")
-        if p == "SQfp16":
-            return FlatIndex(cfg.dim, metric, codec="f16")
-        if p.startswith("PQ"):
-            # flat PQ == IVF-PQ with a single list, always probed
-            idx = IVFPQIndex(cfg.dim, 1, m=parse_pq_m(p), metric=metric)
-            idx.set_nprobe(1)
-            return idx
+    # ---- optional refine suffix ----------------------------------------
+    refine_k = 0
+    if parts and parts[-1] in ("RFlat", "Refine(Flat)"):
+        refine_k = int(cfg.extra.get("refine_k_factor", 8))
+        parts = parts[:-1]
+
+    # ---- optional pre-transform prefix ---------------------------------
+    pre = None  # (kind, arg, d_out)
+    if parts:
+        m_opq = _OPQ_RE.match(parts[0])
+        m_pca = _PCA_RE.match(parts[0])
+        if m_opq:
+            d_out = int(m_opq.group(2)) if m_opq.group(2) else cfg.dim
+            pre = ("opq", int(m_opq.group(1)), d_out)
+            parts = parts[1:]
+        elif m_pca:
+            pre = ("pca", None, int(m_pca.group(1)))
+            parts = parts[1:]
+        if pre is not None and pre[2] > cfg.dim:
+            raise RuntimeError(
+                f"pre-transform output dim {pre[2]} > input dim {cfg.dim} in {spec!r}"
+            )
+    dim = pre[2] if pre else cfg.dim
+
+    def build_core() -> "FlatIndex":
+        if len(parts) == 1:
+            p = parts[0]
+            if p == "Flat":
+                return FlatIndex(dim, metric)
+            if p == "SQ8":
+                return FlatIndex(dim, metric, codec="sq8")
+            if p == "SQfp16":
+                return FlatIndex(dim, metric, codec="f16")
+            if p.startswith("PQ"):
+                # flat PQ == IVF-PQ with a single list, always probed
+                idx = IVFPQIndex(dim, 1, m=parse_pq_m(p), metric=metric,
+                                 refine_k_factor=refine_k)
+                idx.set_nprobe(1)
+                return idx
+            if _HNSW_RE.match(p):
+                return _build_hnsw_spec(int(_HNSW_RE.match(p).group(1)), dim, cfg)
+        if len(parts) == 2 and _HNSW_RE.match(parts[0]):
+            if parts[1] not in ("Flat", "SQ8"):
+                raise RuntimeError(f"unsupported HNSW storage {parts[1]!r} in {spec!r}")
+            return _build_hnsw_spec(int(_HNSW_RE.match(parts[0]).group(1)), dim, cfg)
+        if len(parts) == 2 and parts[0].startswith("IVF"):
+            nlist = int(parts[0][3:])
+            tail = parts[1]
+            if tail == "Flat":
+                return IVFFlatIndex(dim, nlist, metric, "f32", kmeans_iters=iters)
+            if tail == "SQ8":
+                # RFlat composes: exact fp16 rerank of the sq8 shortlist
+                return IVFFlatIndex(dim, nlist, metric, "sq8", kmeans_iters=iters,
+                                    refine_k_factor=refine_k)
+            if tail in ("SQfp16", "SQ16"):
+                return IVFFlatIndex(dim, nlist, metric, "f16", kmeans_iters=iters)
+            if tail.startswith("PQ"):
+                return IVFPQIndex(dim, nlist, m=parse_pq_m(tail), metric=metric,
+                                  kmeans_iters=iters, refine_k_factor=refine_k)
         raise RuntimeError(f"unsupported factory spec {spec!r}")
 
-    if len(parts) == 2 and parts[0].startswith("IVF"):
-        nlist = int(parts[0][3:])
-        tail = parts[1]
-        if tail == "Flat":
-            return IVFFlatIndex(cfg.dim, nlist, metric, "f32", kmeans_iters=iters)
-        if tail == "SQ8":
-            return IVFFlatIndex(cfg.dim, nlist, metric, "sq8", kmeans_iters=iters)
-        if tail in ("SQfp16", "SQ16"):
-            return IVFFlatIndex(cfg.dim, nlist, metric, "f16", kmeans_iters=iters)
-        if tail.startswith("PQ"):
-            return IVFPQIndex(cfg.dim, nlist, m=parse_pq_m(tail), metric=metric,
-                              kmeans_iters=iters)
-    raise RuntimeError(f"unsupported factory spec {spec!r}")
+    core = build_core()
+    if refine_k and not getattr(core, "refine_k_factor", 0):
+        # accurate rationale per inner: f32 inners already score exactly;
+        # fp16 inners match the refine store's own precision; anything else
+        # (e.g. HNSW's sq8 graph) simply doesn't wire refine yet
+        exact = isinstance(core, (FlatIndex, IVFFlatIndex)) and \
+            getattr(core, "codec", "f32") == "f32"
+        logging.getLogger().warning(
+            "RFlat suffix on %r: %s; refine ignored", spec,
+            "inner index scores are already exact fp32" if exact
+            else "refine is not wired for this inner index (recall may "
+                 "trail FAISS's Refine(Flat) here)",
+        )
+    if pre is None:
+        return core
+
+    if refine_k and pre[2] < cfg.dim and getattr(core, "refine_k_factor", 0):
+        logging.getLogger().warning(
+            "RFlat under a dim-reducing pre-transform (%r): rerank happens in "
+            "the reduced %d-dim space and cannot recover projection error "
+            "(FAISS IndexRefineFlat reranks full-dim rows)", spec, pre[2]
+        )
+
+    from distributed_faiss_tpu.models.pretransform import PreTransformIndex
+
+    kind, arg, d_out = pre
+    if core.dim != d_out:
+        raise RuntimeError(f"pre-transform output dim {d_out} mismatch in {spec!r}")
+    if kind == "opq":
+        return PreTransformIndex(core, cfg.dim, opq_m=arg,
+                                 opq_iters=int(cfg.extra.get("opq_iters", 8)))
+    return PreTransformIndex(core, cfg.dim, pca=True)
+
+
+def _build_hnsw_spec(M: int, dim: int, cfg: IndexCfg):
+    """HNSW<M> factory spec -> native graph (SQ8 storage), mirroring the
+    hnswsq builder's fallback discipline."""
+    if cfg.metric != "l2":
+        raise RuntimeError("HNSW factory specs support l2 only (reference index.py:52)")
+    from distributed_faiss_tpu.models import hnsw
+
+    if hnsw.native_available():
+        return hnsw.HNSWSQIndex(
+            dim, "l2", M=M,
+            ef_construction=int(cfg.extra.get("ef_construction", 100)),
+        )
+    return FlatIndex(dim, "l2", codec="sq8")
 
 
 def build_index(cfg: IndexCfg):
@@ -266,6 +370,12 @@ def _sharded_ivf_pq_cls():
     return ShardedIVFPQIndex
 
 
+def _pretransform_cls():
+    from distributed_faiss_tpu.models.pretransform import PreTransformIndex
+
+    return PreTransformIndex
+
+
 _STATE_KINDS = {
     "flat": lambda: FlatIndex,
     "ivf_flat": lambda: IVFFlatIndex,
@@ -274,6 +384,7 @@ _STATE_KINDS = {
     "sharded_ivf_flat": _sharded_ivf_cls,
     "sharded_ivf_pq": _sharded_ivf_pq_cls,
     "hnswsq": _hnswsq_cls,
+    "pretransform": _pretransform_cls,
 }
 
 

@@ -23,36 +23,86 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_TILE = 512
 
+# The fused one-hot scratch is the VMEM budget driver: ONE (TILE, m*ksub)
+# f32 buffer (built in place, reused every grid step). Measured on TPU
+# v5e: the earlier per-subspace variant made Mosaic stack-allocate one
+# (TILE, ksub) buffer per statically unrolled subspace with NO
+# cross-iteration reuse — m=64/TILE=512 demanded 43.5 MB of scoped VMEM
+# against the 16 MB limit. A single scratch ref sidesteps that allocator
+# behavior and turns the scan into one big MXU matmul per tile.
+_ONEHOT_VMEM_BUDGET = 8 * 1024 * 1024
 
-def _on_tpu() -> bool:
+
+def _fit_tile(tile: int, m: int, ksub: int, L: int, itemsize: int = 4,
+              interpret: bool = False) -> int:
+    if interpret:
+        # the interpreter has no VMEM; keep the pre-round-2 clamp so CPU
+        # tests can run any geometry
+        return min(tile, max(8, L))
+    fit = _ONEHOT_VMEM_BUDGET // (m * ksub * itemsize)
+    fit = (fit // 128) * 128  # lane-aligned output blocks
+    if fit < 128:
+        # even the minimum lane-aligned tile would overflow scoped VMEM
+        # (plus the LUT block); raising at trace time is deliberate — the
+        # IVF-PQ models' guarded fallback catches it and retries the XLA
+        # one-hot path (use a bf16 LUT to halve the footprint instead)
+        raise ValueError(
+            f"pallas ADC: PQ geometry m={m} ksub={ksub} itemsize={itemsize} "
+            f"exceeds the VMEM one-hot budget at the minimum 128-row tile"
+        )
+    return min(tile, fit, max(8, L))
+
+
+def on_tpu() -> bool:
+    """True when jax dispatches to a real TPU (the axon relay's PJRT
+    platform registers as 'tpu' but keep 'axon' for robustness — the ONE
+    shared predicate deciding compiled-vs-interpreted kernel mode)."""
     try:
-        return jax.default_backend() == "tpu"
+        return jax.default_backend() in ("tpu", "axon")
     except RuntimeError:  # pragma: no cover
         return False
 
 
-def _adc_accumulate(m: int, ksub: int, lut, codes):
-    """lut: (nq, m*ksub) f32; codes: (TILE, m) u8 -> (nq, TILE) f32."""
+_on_tpu = on_tpu  # back-compat alias
+
+
+def _build_onehot(m: int, ksub: int, codes, onehot_ref):
+    """Scatter codes (TILE, m) u8 into onehot_ref (TILE, m*ksub):
+    row c gets a 1 at column mi*ksub + codes[c, mi] for each subspace.
+    The one-hot inherits the scratch dtype — 0/1 are exact in bf16, so a
+    bf16 LUT halves VMEM traffic (the kernel's bottleneck) losslessly on
+    the one-hot side."""
     tile = codes.shape[0]
-    nq = lut.shape[0]
     iota = jax.lax.broadcasted_iota(jnp.int32, (tile, ksub), 1)
-    acc = jnp.zeros((nq, tile), jnp.float32)
-    for mi in range(m):  # static unroll: m is a compile-time constant
+    for mi in range(m):  # static unroll; each store reuses the same scratch
         cm = codes[:, mi].astype(jnp.int32).reshape(tile, 1)
-        onehot = (cm == iota).astype(jnp.float32)  # (TILE, ksub) on the VPU
-        lut_m = lut[:, mi * ksub:(mi + 1) * ksub]  # (nq, ksub)
-        # HIGHEST: match the XLA ADC path (pq.py) — default bf16 MXU passes
-        # perturb lut values enough to reorder near-tie candidates
-        acc = acc + jnp.dot(lut_m, onehot.T, precision=jax.lax.Precision.HIGHEST,
-                            preferred_element_type=jnp.float32)
-    return acc
+        onehot_ref[:, mi * ksub:(mi + 1) * ksub] = (cm == iota).astype(onehot_ref.dtype)
 
 
-def _adc_kernel(m: int, ksub: int, lut_ref, codes_ref, out_ref):
-    out_ref[:, :] = _adc_accumulate(m, ksub, lut_ref[:, :], codes_ref[:, :])
+def _adc_matmul(lut, onehot):
+    """(nq, m*ksub) x (TILE, m*ksub) -> (nq, TILE), contracting m*ksub on
+    the MXU, f32 accumulate. HIGHEST: for f32 LUTs this matches the XLA
+    ADC path (pq.py) bit-for-bit intent; for bf16 LUTs the MXU's native
+    bf16 pass is already exact given bf16 inputs."""
+    # HIGHEST's multi-pass trick only exists for f32 operands; on bf16
+    # inputs Mosaic rejects it ("Bad lhs type") — and the native bf16 MXU
+    # pass is already exact for bf16 inputs, so DEFAULT is the right ask.
+    precision = (jax.lax.Precision.HIGHEST if lut.dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
+    return jax.lax.dot_general(
+        lut, onehot, (((1,), (1,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _adc_kernel(m: int, ksub: int, lut_ref, codes_ref, out_ref, onehot_ref):
+    _build_onehot(m, ksub, codes_ref[:, :], onehot_ref)
+    out_ref[:, :] = _adc_matmul(lut_ref[:, :], onehot_ref[:, :])
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
@@ -65,7 +115,7 @@ def adc_scan_shared_pallas(lut, codes, tile: int = DEFAULT_TILE, interpret: bool
     """
     nq, m, ksub = lut.shape
     L = codes.shape[0]
-    tile = min(tile, max(8, L))
+    tile = _fit_tile(tile, m, ksub, L, jnp.dtype(lut.dtype).itemsize, interpret)
     Lp = -(-L // tile) * tile
     if Lp != L:
         codes = jnp.pad(codes, ((0, Lp - L), (0, 0)))
@@ -78,6 +128,7 @@ def adc_scan_shared_pallas(lut, codes, tile: int = DEFAULT_TILE, interpret: bool
         ],
         out_specs=pl.BlockSpec((nq, tile), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((nq, Lp), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((tile, m * ksub), lut.dtype)],
         interpret=interpret,
     )(lut.reshape(nq, m * ksub), codes)
     return out[:, :L]
@@ -93,26 +144,32 @@ def adc_scan_pallas(lut, codes, tile: int = DEFAULT_TILE, interpret: bool = Fals
     """
     nq, m, ksub = lut.shape
     L = codes.shape[1]
-    tile = min(tile, max(8, L))
+    tile = _fit_tile(tile, m, ksub, L, jnp.dtype(lut.dtype).itemsize, interpret)
     Lp = -(-L // tile) * tile
     if Lp != L:
         codes = jnp.pad(codes, ((0, 0), (0, Lp - L), (0, 0)))
 
-    def kernel(lut_ref, codes_ref, out_ref):
-        # lut_ref: (1, m*ksub); codes_ref: (1, tile, m); out_ref: (1, 1, tile)
-        out_ref[0, :, :] = _adc_accumulate(m, ksub, lut_ref[:, :], codes_ref[0])
+    def kernel(lut_ref, codes_ref, out_ref, onehot_ref):
+        # lut_ref: (1, 1, m*ksub); codes_ref: (1, tile, m); out_ref: (1, 1, tile)
+        _build_onehot(m, ksub, codes_ref[0], onehot_ref)
+        out_ref[0, :, :] = _adc_matmul(lut_ref[0], onehot_ref[:, :])
 
+    # lut rides as (nq, 1, m*ksub): compiled Mosaic requires the last two
+    # block dims be 8/128-divisible OR equal to the full array dims — a
+    # (1, m*ksub) block of a (nq, m*ksub) array violates that, a
+    # (1, 1, m*ksub) block of (nq, 1, m*ksub) satisfies it.
     out = pl.pallas_call(
         kernel,
         grid=(nq, Lp // tile),
         in_specs=[
-            pl.BlockSpec((1, m * ksub), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, 1, m * ksub), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, tile, m), lambda i, j: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, tile), lambda i, j: (i, 0, j)),
         out_shape=jax.ShapeDtypeStruct((nq, 1, Lp), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((tile, m * ksub), lut.dtype)],
         interpret=interpret,
-    )(lut.reshape(nq, m * ksub), codes)
+    )(lut.reshape(nq, 1, m * ksub), codes)
     return out[:, 0, :L]
 
 

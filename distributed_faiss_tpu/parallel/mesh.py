@@ -612,11 +612,11 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric",
-                                             "use_pallas", "adc_k"))
+                                             "use_pallas", "adc_k", "lut_bf16"))
 def _sharded_ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_sizes,
                            q, mesh, k: int, nprobe: int, g: int, metric: str,
                            use_pallas: bool = False, adc_k: int = 0,
-                           raw_data=None):
+                           raw_data=None, lut_bf16: bool = False):
     """IVF-PQ with mesh-sharded code lists: per-chip ADC over owned probes
     (residual LUTs for l2 computed locally against replicated centroids),
     ICI all_gather merge. Same ownership masking trade-off as
@@ -673,7 +673,9 @@ def _sharded_ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_size
                 from distributed_faiss_tpu.ops import adc_pallas
 
                 s = adc_pallas.adc_scan_auto(
-                    lut.reshape(nq * g, m, ksub), codes.reshape(nq * g, cap, m)
+                    lut.reshape(nq * g, m, ksub).astype(
+                        jnp.bfloat16 if lut_bf16 else jnp.float32),
+                    codes.reshape(nq * g, cap, m),
                 ).reshape(nq, g, cap)
             else:
                 iota = jnp.arange(ksub, dtype=jnp.int32)
@@ -741,10 +743,11 @@ class ShardedIVFPQIndex(IVFPQIndex):
                  metric: str = "l2", mesh: Optional[Mesh] = None,
                  kmeans_iters: int = 10, pq_iters: int = 15,
                  probe_routing: bool = False, use_pallas: bool = False,
-                 refine_k_factor: int = 0):
+                 refine_k_factor: int = 0, adc_lut_bf16: bool = False):
         super().__init__(dim, nlist, m=m, nbits=nbits, metric=metric,
                          kmeans_iters=kmeans_iters, pq_iters=pq_iters,
-                         use_pallas=use_pallas, refine_k_factor=refine_k_factor)
+                         use_pallas=use_pallas, refine_k_factor=refine_k_factor,
+                         adc_lut_bf16=adc_lut_bf16)
         # the single-device refine store the parent builds is replaced by a
         # mesh-sharded raw-row store laid out exactly like the code lists
         self.refine_store = None
@@ -796,15 +799,17 @@ class ShardedIVFPQIndex(IVFPQIndex):
                 self.lists.ids, self.lists.sizes, block, n, self.mesh, k,
                 nprobe, bucket, group, self.metric, use_pallas=pallas_on,
                 adc_k=adc_k, raw_data=raw,
+                lut_bf16=pallas_on and self.adc_lut_bf16,
             )
 
         def run_masked(b, pallas_on):
-            per_probe = 256 * self.lists.cap * (self.m + 8) + 256 * self.m * 256 * 4
-            g = probe_group_size(nprobe, per_probe)
+            g = probe_group_size(
+                nprobe, ivfmod.pq_probe_payload_bytes(self.lists.cap, self.m))
             return _sharded_ivf_pq_search(
                 self.centroids, self.codebooks, self.lists.data, self.lists.ids,
                 self.lists.sizes, b, self.mesh, k, nprobe, g, self.metric,
                 use_pallas=pallas_on, adc_k=adc_k, raw_data=raw,
+                lut_bf16=pallas_on and self.adc_lut_bf16,
             )
 
         def guarded(call, *args):
@@ -851,7 +856,8 @@ class ShardedIVFPQIndex(IVFPQIndex):
                   nbits=int(state["nbits"]), metric=str(state["metric"]),
                   probe_routing=bool(state.get("probe_routing", False)),
                   use_pallas=bool(state.get("use_pallas", False)),
-                  refine_k_factor=int(state.get("refine_k_factor", 0)))
+                  refine_k_factor=int(state.get("refine_k_factor", 0)),
+                  adc_lut_bf16=bool(state.get("adc_lut_bf16", False)))
         idx.nprobe = int(state["nprobe"])
         if not bool(state["trained"]):
             return idx
@@ -1062,12 +1068,13 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "pair_bucket",
                                              "group", "metric", "use_pallas",
-                                             "adc_k"))
+                                             "adc_k", "lut_bf16"))
 def _sharded_ivf_pq_search_routed(centroids, codebooks, list_codes, list_ids,
                                   list_sizes, q, nq_real, mesh, k: int,
                                   nprobe: int, pair_bucket: int, group: int,
                                   metric: str, use_pallas: bool = False,
-                                  adc_k: int = 0, raw_data=None):
+                                  adc_k: int = 0, raw_data=None,
+                                  lut_bf16: bool = False):
     """Probe-routed sharded IVF-PQ: per-pair residual LUTs + ADC (one-hot
     einsum or fused pallas kernel) over owned pairs only (same scaffold as
     the flat variant). adc_k/raw_data enable pre-merge exact refine — see
@@ -1097,7 +1104,9 @@ def _sharded_ivf_pq_search_routed(centroids, codebooks, list_codes, list_ids,
             if use_pallas:
                 from distributed_faiss_tpu.ops import adc_pallas
 
-                s = adc_pallas.adc_scan_auto(lut, codes)  # (g, cap)
+                s = adc_pallas.adc_scan_auto(
+                    lut.astype(jnp.bfloat16 if lut_bf16 else jnp.float32),
+                    codes)  # (g, cap)
             else:
                 iota = jnp.arange(ksub, dtype=jnp.int32)
                 onehot = (codes[..., None].astype(jnp.int32) == iota).astype(jnp.float32)

@@ -19,6 +19,16 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The container's axon sitecustomize calls jax.config.update(
+# "jax_platforms", "axon,cpu") at interpreter start, which OUTRANKS the
+# JAX_PLATFORMS env var — with a live relay the whole suite would silently
+# run on the single real TPU chip (and test_virtual_mesh_has_8_devices
+# fails 1 != 8). Re-assert cpu through the same config channel; safe
+# because no backend has initialized yet at conftest import time.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

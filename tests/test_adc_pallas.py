@@ -46,6 +46,44 @@ def test_per_query_kernel_golden(rng):
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+def test_bf16_lut_close_to_f32(problem):
+    """bf16 LUT (the fast serving mode, 1.5x on TPU v5e): one-hot side is
+    exact, so error is bounded by bf16 rounding of the LUT entries."""
+    import jax.numpy as jnp
+
+    lut, codes = problem
+    got = np.asarray(adc_pallas.adc_scan_shared_pallas(
+        jnp.asarray(lut).astype(jnp.bfloat16), codes, tile=128, interpret=True))
+    want = np_adc(lut, codes)
+    # m=4 sums of bf16-rounded values (~0.4% rel each)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_bf16_lut_ivfpq_with_refine_recall(rng):
+    """End-to-end: adc_lut_bf16 + refine matches the f32 pipeline's recall
+    (the refine stage rescores the shortlist exactly either way)."""
+    from distributed_faiss_tpu.models.ivf import IVFPQIndex
+
+    n, d = 3000, 32
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((16, d)).astype(np.float32)
+
+    def build(**kw):
+        idx = IVFPQIndex(d, 16, m=8, metric="l2", kmeans_iters=4, pq_iters=4,
+                         refine_k_factor=4, **kw)
+        idx.train(x[:2000])
+        idx.add(x)
+        idx.set_nprobe(8)
+        return idx
+
+    _, ids_f32 = build(use_pallas=True).search(q, 10)
+    _, ids_bf16 = build(use_pallas=True, adc_lut_bf16=True).search(q, 10)
+    overlap = np.mean([
+        len(set(ids_f32[i]) & set(ids_bf16[i])) / 10 for i in range(len(q))
+    ])
+    assert overlap >= 0.9, overlap
+
+
 def test_tiny_list(rng):
     lut = rng.standard_normal((2, 4, 256)).astype(np.float32)
     codes = rng.integers(0, 256, (3, 4)).astype(np.uint8)
