@@ -1,0 +1,61 @@
+"""OPQ: optimized product quantization rotation (OPQ-NP training).
+
+FAISS exposes this as ``OPQMatrix`` via factory strings like
+``"OPQ16,IVF4096,PQ16"`` (the full grammar behind the reference's
+``faiss.index_factory`` call, distributed_faiss/index.py:396). The rotation
+R (orthonormal columns, optionally dim-reducing) is trained to minimize PQ
+reconstruction error by alternating:
+
+  1. PQ-train codebooks on the rotated training set x @ R
+  2. procrustes update: R <- U V^T from the SVD of x^T x_hat, the
+     orthogonal transform best aligning x with its reconstruction
+
+All matmuls are jitted (the x^T x_hat gram is the FLOPs hot spot — n*d^2);
+the (d, d_out) SVD itself is tiny and runs wherever lax.linalg puts it.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_faiss_tpu.ops import pq
+
+
+@functools.partial(jax.jit, static_argnames=("m",))
+def _reconstruct(xr, m: int, codebooks):
+    return pq.pq_decode(pq._pq_encode_block(xr, codebooks), codebooks)
+
+
+@jax.jit
+def _procrustes(x, xhat):
+    """R = U V^T minimizing ||x R - xhat||_F over orthonormal-column R."""
+    g = jnp.einsum("nd,ne->de", x, xhat, precision=jax.lax.Precision.HIGHEST)
+    u, _, vt = jnp.linalg.svd(g, full_matrices=False)
+    return u @ vt
+
+
+def opq_train(x, m: int, d_out: int = None, opq_iters: int = 10,
+              pq_iters: int = 6, seed: int = 0):
+    """Train the OPQ rotation. Returns (R, codebooks): R is (d, d_out)
+    float32 with orthonormal columns; codebooks are the PQ codebooks
+    trained on the rotated data in the final iteration (callers may retrain
+    their own — e.g. IVF residual PQ trains on rotated residuals)."""
+    x = jnp.asarray(x, jnp.float32)
+    d = x.shape[1]
+    d_out = d if d_out is None else d_out
+    if d_out > d:
+        raise ValueError(f"OPQ d_out {d_out} > input dim {d}")
+    if d_out % m != 0:
+        raise ValueError(f"OPQ output dim {d_out} not divisible by m={m}")
+    rng = np.random.default_rng(seed)
+    q, _ = np.linalg.qr(rng.standard_normal((d, d)).astype(np.float32))
+    r = jnp.asarray(q[:, :d_out], jnp.float32)
+    codebooks = None
+    for it in range(opq_iters):
+        xr = x @ r
+        codebooks = pq.pq_train(xr, m, iters=pq_iters, seed=seed + it)
+        xhat = _reconstruct(xr, m, codebooks)
+        r = _procrustes(x, xhat)
+    return r, codebooks
