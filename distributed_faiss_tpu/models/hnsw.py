@@ -13,7 +13,9 @@ the factory falls back to the exact sq8 flat scan (models/flat.py).
 
 Concurrency: graph construction is multi-threaded (striped per-node locks,
 fixed-capacity atomic adjacency — the same discipline FAISS's OpenMP HNSW
-uses), batched searches fan out over a thread pool, and concurrent
+uses), batched add/search calls fan out over worker threads spawned per
+native call (not a persistent pool — per-call spawn cost is only visible
+for tiny batches at high QPS on many-core hosts), and concurrent
 ``search`` calls on one instance are safe (per-call pooled visited tables;
 ctypes releases the GIL for the duration of the native call). The one
 exclusion callers must keep: ``add`` must not overlap ``search``/``save``

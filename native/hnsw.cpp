@@ -20,11 +20,14 @@
 //     out-of-bounds neighbor. (This is why capacities are fixed: a growable
 //     vector would invalidate concurrent readers on realloc.)
 //   - add_batch() appends codes/levels/link-frames sequentially (cheap),
-//     then builds the graph links for the batch on a thread pool. Only one
-//     stripe lock is ever held at a time -> no deadlock.
+//     then builds the graph links for the batch on per-call worker threads
+//     (parallel_for below spawns+joins std::threads each call — NOT a
+//     persistent pool; fine for big build batches, and search's per-call
+//     spawn cost only matters for tiny high-QPS batches on many-core
+//     hosts). Only one stripe lock is ever held at a time -> no deadlock.
 //   - search() is lock-free w.r.t. the graph and uses a pooled per-call
 //     visited table, so concurrent searches on ONE graph are safe; batched
-//     queries also fan out over the thread pool.
+//     queries also fan out over per-call worker threads.
 //   - The one remaining exclusion the CALLER must provide: add_batch() must
 //     not overlap search()/save() (codes_/levels_ vectors grow). The engine's
 //     index_lock already provides this in the serving path.

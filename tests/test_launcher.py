@@ -43,6 +43,64 @@ def test_file_lock_contention(tmp_path):
     launcher.release_file_lock(lock2)
 
 
+def test_slurm_launch_with_mocked_submitit(tmp_path, monkeypatch):
+    """launch_slurm never runs in this image (submitit absent), so exercise it
+    against a mock: verify the executor parameters mirror the reference's
+    AutoExecutor setup (server_launcher.py:111-122) and that the submitted
+    task derives rank from global_rank and port from base_port+local_rank
+    (reference :59-68)."""
+    import types
+
+    recorded = {}
+
+    class FakeJobEnvironment:
+        global_rank = 5
+        local_rank = 2
+
+    class FakeAutoExecutor:
+        def __init__(self, folder):
+            recorded["folder"] = folder
+
+        def update_parameters(self, **kw):
+            recorded["params"] = kw
+
+        def submit(self, fn):
+            recorded["task"] = fn
+            return "fake-job"
+
+    fake = types.ModuleType("submitit")
+    fake.JobEnvironment = FakeJobEnvironment
+    fake.AutoExecutor = FakeAutoExecutor
+    monkeypatch.setitem(sys.modules, "submitit", fake)
+
+    disc = str(tmp_path / "disc.txt")
+    job = launcher.launch_slurm(
+        num_servers=6, num_servers_per_node=2, discovery_path=disc,
+        storage_dir=str(tmp_path / "st"), base_port=14000, partition="learnlab",
+    )
+    assert job == "fake-job"
+    assert open(disc).readline().strip() == "6"
+    p = recorded["params"]
+    assert p["nodes"] == 3 and p["tasks_per_node"] == 2
+    assert p["slurm_partition"] == "learnlab"
+
+    served = {}
+    monkeypatch.setattr(
+        launcher, "run_server",
+        lambda rank, port, dp, sd, load: served.update(
+            rank=rank, port=port, disc=dp, storage=sd, load=load),
+    )
+    recorded["task"]()  # what submitit would run on the SLURM task
+    assert served["rank"] == 5 and served["port"] == 14002
+    assert served["disc"] == disc and served["load"] is False
+
+
+def test_slurm_launch_without_submitit_raises(monkeypatch, tmp_path):
+    monkeypatch.setitem(sys.modules, "submitit", None)
+    with pytest.raises(RuntimeError, match="submitit is not installed"):
+        launcher.launch_slurm(1, 1, str(tmp_path / "d.txt"), str(tmp_path / "s"))
+
+
 @pytest.mark.slow
 def test_local_launch_end_to_end(tmp_path):
     """Full L5 path: launch_local subprocesses -> client -> ingest -> search,
