@@ -109,10 +109,11 @@ def pq_probe_payload_bytes(cap: int, m: int, ksub: int = 256) -> int:
 
 
 def _merge_group(carry, s, ids, k):
-    """Merge a (nq, width) score block + ids into the running (nq, k) top-k."""
+    """Merge a (nq, width) score block + ids into the running (nq, k) top-k
+    (two-stage segmented top-k: width can reach g*cap ~ tens of thousands,
+    where single-pass lax.top_k dominates the probe scan)."""
     best_v, best_i = carry
-    cv, cp = jax.lax.top_k(s, min(k, s.shape[1]))
-    cids = jnp.take_along_axis(ids, cp, axis=1)
+    cv, cids = distance.segmented_topk_rows(s, k, ids)
     return distance.merge_topk(best_v, best_i, cv, cids, k)
 
 

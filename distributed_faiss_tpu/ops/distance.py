@@ -77,6 +77,52 @@ def merge_topk(vals_a, ids_a, vals_b, ids_b, k: int):
     return best, jnp.take_along_axis(ids, pos, axis=1)
 
 
+# lax.top_k cost grows super-linearly with row width on TPU (sorting-network
+# passes over the whole row); the 65,536-wide per-chunk top-k — not the MXU
+# matmul — dominated the flat scan. Exact two-stage reduction: per-segment
+# top-k (every global top-k element is inside its own segment's top-k, so
+# the union is an exact superset), then one narrow top-k over G*k.
+_TOPK_SEGMENT = 2048
+
+
+def _seg_reduce(s, k: int):
+    """Exact top-k over rows of (nq, W) scores via the two-stage reduction.
+
+    Returns (vals, pos) with pos indexing the ORIGINAL columns. Non-aligned
+    widths are padded with NEG_INF (so every wide row takes the fast path);
+    a padded column can only surface when a row has fewer than k finite
+    entries, and is clamped to w-1 — its NEG_INF score already marks it
+    invalid, matching plain top_k's garbage-id-for-masked-entry semantics.
+    Falls back to single-pass top_k only for narrow rows or k > segment.
+    """
+    nq, w = s.shape
+    seg = _TOPK_SEGMENT
+    kk = min(k, w)
+    if w <= 2 * seg or kk > seg:
+        return jax.lax.top_k(s, kk)
+    wp = -(-w // seg) * seg
+    if wp != w:
+        s = jnp.pad(s, ((0, 0), (0, wp - w)), constant_values=NEG_INF)
+    g = wp // seg
+    sv, sp = jax.lax.top_k(s.reshape(nq, g, seg), kk)         # (nq, g, kk)
+    flat = (jnp.arange(g, dtype=jnp.int32) * seg)[None, :, None] + sp
+    cv, cp = jax.lax.top_k(sv.reshape(nq, g * kk), kk)
+    pos = jnp.take_along_axis(flat.reshape(nq, g * kk), cp, axis=1)
+    return cv, jnp.minimum(pos, w - 1)
+
+
+def segmented_topk(s, k: int, gids):
+    """Exact top-k of (nq, W) scores; gids: (W,) int32 column ids."""
+    cv, pos = _seg_reduce(s, k)
+    return cv, jnp.take(gids, pos)
+
+
+def segmented_topk_rows(s, k: int, ids):
+    """segmented_topk for per-row id arrays: s, ids both (nq, W)."""
+    cv, pos = _seg_reduce(s, k)
+    return cv, jnp.take_along_axis(ids, pos, axis=1)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "metric", "chunk", "codec"))
 def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int, codec: str = "raw",
               vmin=None, span=None):
@@ -123,8 +169,7 @@ def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int, codec: str = "raw",
         base = ci * chunk
         gids = base + jnp.arange(chunk, dtype=jnp.int32)
         s = jnp.where(gids[None, :] < ntotal, s, NEG_INF)
-        cv, cp = jax.lax.top_k(s, min(k, chunk))
-        cids = jnp.take(gids, cp)
+        cv, cids = segmented_topk(s, min(k, chunk), gids)
         return merge_topk(best_v, best_i, cv, cids, k), None
 
     (vals, ids), _ = jax.lax.scan(

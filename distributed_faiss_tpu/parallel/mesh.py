@@ -518,8 +518,8 @@ def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
             valid = valid & (ids >= 0) & mine[:, :, None]
             s = jnp.where(valid, s, distance.NEG_INF)
             ids = jnp.where(valid, ids, -1)
-            cv, cp = jax.lax.top_k(s.reshape(nq, g * cap), min(k, g * cap))
-            cids = jnp.take_along_axis(ids.reshape(nq, g * cap), cp, axis=1)
+            cv, cids = distance.segmented_topk_rows(
+                s.reshape(nq, g * cap), min(k, g * cap), ids.reshape(nq, g * cap))
             return distance.merge_topk(best_v, best_i, cv, cids, k), None
 
         (vals, ids), _ = jax.lax.scan(body, init, groups)
@@ -690,8 +690,8 @@ def _sharded_ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_size
             # gathers (ids always; raw rows when refining)
             pos = slot[:, :, None] * cap + jnp.arange(cap, dtype=jnp.int32)[None, None, :]
             pos = jnp.where(valid, pos, -1)
-            cv, cp = jax.lax.top_k(s.reshape(nq, g * cap), min(local_k, g * cap))
-            cpos = jnp.take_along_axis(pos.reshape(nq, g * cap), cp, axis=1)
+            cv, cpos = distance.segmented_topk_rows(
+                s.reshape(nq, g * cap), min(local_k, g * cap), pos.reshape(nq, g * cap))
             return distance.merge_topk(carry[0], carry[1], cv, cpos, local_k), None
 
         (vals, pos), _ = jax.lax.scan(body, init, groups)

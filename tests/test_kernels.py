@@ -142,3 +142,43 @@ def test_bucket_and_pad():
     assert p.shape == (8, 4)
     np.testing.assert_array_equal(p[:3], x)
     assert p[3:].sum() == 0
+
+
+def test_segmented_topk_matches_plain(rng):
+    import jax.numpy as jnp
+    from distributed_faiss_tpu.ops import distance
+
+    nq, w, k = 4, 8192, 10  # w a multiple of the segment width
+    s = jnp.asarray(rng.standard_normal((nq, w)).astype(np.float32))
+    gids = jnp.arange(w, dtype=jnp.int32) + 100
+    sv, si = distance.segmented_topk(s, k, gids)
+    import jax
+    pv, pp = jax.lax.top_k(s, k)
+    np.testing.assert_allclose(np.asarray(sv), np.asarray(pv))
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(pp) + 100)
+
+
+def test_segmented_topk_fallback_narrow(rng):
+    import jax.numpy as jnp
+    from distributed_faiss_tpu.ops import distance
+
+    s = jnp.asarray(rng.standard_normal((3, 500)).astype(np.float32))
+    gids = jnp.arange(500, dtype=jnp.int32)
+    sv, si = distance.segmented_topk(s, 7, gids)
+    assert sv.shape == (3, 7) and si.shape == (3, 7)
+    assert np.all(np.diff(np.asarray(sv), axis=1) <= 0)
+
+
+def test_segmented_topk_nonaligned_width_padded(rng):
+    """Non-segment-multiple widths take the padded fast path exactly."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_faiss_tpu.ops import distance
+
+    nq, w, k = 3, 5000, 10  # > 2*seg, not a multiple of 2048
+    s = jnp.asarray(rng.standard_normal((nq, w)).astype(np.float32))
+    gids = jnp.arange(w, dtype=jnp.int32)
+    sv, si = distance.segmented_topk(s, k, gids)
+    pv, pp = jax.lax.top_k(s, k)
+    np.testing.assert_allclose(np.asarray(sv), np.asarray(pv))
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(pp))
