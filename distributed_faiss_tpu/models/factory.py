@@ -77,6 +77,23 @@ def _build_ivf_simple(cfg: IndexCfg) -> IVFFlatIndex:
 def _build_knnlm(cfg: IndexCfg):
     m = int(cfg.extra.get("code_size", 64))
     nbits = int(cfg.extra.get("nbits", 8))
+    if cfg.extra.get("opq"):
+        # OPQ rotation in front of the IVF-PQ (FAISS "OPQ<m>,IVF,PQ<m>"):
+        # train fits the rotation on the train sample, then the inner index
+        # trains on rotated data. Works for sharded and unsharded inners
+        # (the wrapper delegates everything, incl. state_dict round-trip).
+        from distributed_faiss_tpu.models.pretransform import PreTransformIndex
+
+        # build the inner from the same cfg minus the opq flag (the flag
+        # would otherwise recurse); restore the caller's extra afterwards
+        orig_extra = cfg.extra
+        cfg.extra = dict(orig_extra, opq=False)
+        try:
+            inner = _build_knnlm(cfg)
+        finally:
+            cfg.extra = orig_extra
+        return PreTransformIndex(inner, cfg.dim, opq_m=m,
+                                 opq_iters=int(cfg.extra.get("opq_iters", 8)))
     if cfg.extra.get("shard_lists"):
         from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex
 

@@ -123,7 +123,7 @@ def _ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
                      vmin=None, span=None):
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
-    _, probes = jax.lax.top_k(coarse, nprobe)  # (nq, nprobe)
+    _, probes = distance.segmented_argtopk(coarse, nprobe)  # (nq, nprobe)
     nq = q.shape[0]
     cap = list_data.shape[1]
     qn = jnp.sum(q * q, axis=1, keepdims=True)
@@ -162,7 +162,7 @@ def _ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_sizes, q,
                    use_pallas: bool = False, lut_bf16: bool = False):
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
-    _, probes = jax.lax.top_k(coarse, nprobe)
+    _, probes = distance.segmented_argtopk(coarse, nprobe)
     nq = q.shape[0]
     cap = list_codes.shape[1]
     m, ksub, dsub = codebooks.shape

