@@ -74,7 +74,11 @@ class FlatIndex(base.TpuIndex):
         kwargs = {}
         if self.codec == "sq8":
             kwargs = {"codec": "sq8", "vmin": self.sq_params["vmin"], "span": self.sq_params["span"]}
-        for s, n, block in base.query_blocks(q):
+        # per-query transient is the (nq, chunk) score block of the running
+        # scan — launch-bound serving wants the largest block that keeps it
+        # within budget (see base.pick_query_block)
+        nb = base.pick_query_block(65536 * 4)
+        for s, n, block in base.query_blocks(q, nb):
             vals, ids = distance.knn(
                 block, self.store.data, k, metric=self.metric, ntotal=self.store.ntotal, **kwargs
             )

@@ -100,12 +100,13 @@ def probe_group_size(nprobe: int, per_probe_bytes: int) -> int:
     return g
 
 
-def pq_probe_payload_bytes(cap: int, m: int, ksub: int = 256) -> int:
+def pq_probe_payload_bytes(cap: int, m: int, ksub: int = 256,
+                           nq_block: int = 256) -> int:
     """Per-probed-list payload for the ADC group sizing: gathered codes +
-    ids for a <=256-query block plus the per-probe LUT block. The ONE
-    formula shared by IVFPQIndex.search and the sharded masked path
+    ids for an ``nq_block``-query block plus the per-probe LUT block. The
+    ONE formula shared by IVFPQIndex.search and the sharded masked path
     (parallel/mesh.py) so the memory model can't drift between them."""
-    return 256 * cap * (m + 8) + 256 * m * ksub * 4
+    return nq_block * cap * (m + 8) + nq_block * m * ksub * 4
 
 
 def _merge_group(carry, s, ids, k):
@@ -280,11 +281,11 @@ class _IVFBase(base.TpuIndex):
             self._host_assign = [np.concatenate(self._host_assign)]
         return self._host_assign[0] if self._host_assign else np.zeros((0,), np.int64)
 
-    def _search_blocks(self, q: np.ndarray, k: int, fn):
+    def _search_blocks(self, q: np.ndarray, k: int, fn, block: int = 256):
         nq = q.shape[0]
         out_s = np.empty((nq, k), np.float32)
         out_i = np.empty((nq, k), np.int64)
-        for s, n, block in base.query_blocks(np.asarray(q, np.float32)):
+        for s, n, block in base.query_blocks(np.asarray(q, np.float32), block):
             vals, ids = fn(jnp.asarray(block))
             out_s[s : s + n] = np.asarray(vals)[:n]
             out_i[s : s + n] = np.asarray(ids)[:n]
@@ -363,8 +364,10 @@ class IVFFlatIndex(_IVFBase):
         if self._n == 0:
             return self._empty_results(q.shape[0], k)
         nprobe = min(self.nprobe, self.nlist)
-        # group payload: the gathered fp32 (nq<=256, g, cap, d) block
-        g = probe_group_size(nprobe, 256 * self.lists.cap * self.dim * 4)
+        # group payload: the gathered fp32 (nb, g, cap, d) block; nb chosen
+        # launch-bound-aware (see base.pick_query_block)
+        nb = base.pick_query_block(self.lists.cap * self.dim * 4)
+        g = probe_group_size(nprobe, nb * self.lists.cap * self.dim * 4)
         extra = {}
         if self.codec == "sq8":
             extra = dict(vmin=self.sq_params["vmin"], span=self.sq_params["span"])
@@ -379,7 +382,7 @@ class IVFFlatIndex(_IVFBase):
                 vals, ids = _rerank_exact(self.refine_store.data, b, ids, k, self.metric)
             return vals, ids
 
-        return self._search_blocks(q, k, run)
+        return self._search_blocks(q, k, run, block=nb)
 
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
         rows = self._host_rows_array()[np.asarray(ids, np.int64)]
@@ -500,7 +503,9 @@ class IVFPQIndex(_IVFBase):
         nprobe = min(self.nprobe, self.nlist)
         # group payload: codes + ids + lut + score blocks (the one-hot feeds
         # the MXU contraction without full materialization)
-        g = probe_group_size(nprobe, pq_probe_payload_bytes(self.lists.cap, self.m))
+        nb = base.pick_query_block(self.lists.cap * (self.m + 8) + self.m * 256 * 4)
+        g = probe_group_size(
+            nprobe, pq_probe_payload_bytes(self.lists.cap, self.m, nq_block=nb))
         adc_k = k * self.refine_k_factor if self.refine_k_factor else k
 
         def adc(b, with_pallas):
@@ -537,7 +542,7 @@ class IVFPQIndex(_IVFBase):
                 vals, ids = _rerank_exact(self.refine_store.data, b, ids, k, self.metric)
             return vals, ids
 
-        return self._search_blocks(q, k, run)
+        return self._search_blocks(q, k, run, block=nb)
 
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
         ids = np.asarray(ids, np.int64)

@@ -303,7 +303,8 @@ class ShardedFlatIndex(base.TpuIndex):
         nq = q.shape[0]
         out_s = np.empty((nq, k), np.float32)
         out_i = np.empty((nq, k), np.int64)
-        for s, n, blockq in base.query_blocks(np.asarray(q, np.float32)):
+        for s, n, blockq in base.query_blocks(np.asarray(q, np.float32),
+                                              base.pick_query_block(65536 * 4)):
             vals, ids = sharded_knn(
                 self.mesh, jnp.asarray(blockq), self._dev, self._ntotals, k, self.metric
             )
@@ -578,13 +579,15 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
                     group, self.metric,
                 ),
             )
-        gsz = probe_group_size(nprobe, 256 * self.lists.cap * self.dim * 4)
+        nb = base.pick_query_block(self.lists.cap * self.dim * 4)
+        gsz = probe_group_size(nprobe, nb * self.lists.cap * self.dim * 4)
         return self._search_blocks(
             q, k,
             lambda b: _sharded_ivf_flat_search(
                 self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
                 b, self.mesh, k, nprobe, gsz, self.metric,
             ),
+            block=nb,
         )
 
     def state_dict(self):
@@ -802,9 +805,13 @@ class ShardedIVFPQIndex(IVFPQIndex):
                 lut_bf16=pallas_on and self.adc_lut_bf16,
             )
 
+        nb = base.pick_query_block(
+            self.lists.cap * (self.m + 8) + self.m * 256 * 4)
+
         def run_masked(b, pallas_on):
             g = probe_group_size(
-                nprobe, ivfmod.pq_probe_payload_bytes(self.lists.cap, self.m))
+                nprobe,
+                ivfmod.pq_probe_payload_bytes(self.lists.cap, self.m, nq_block=nb))
             return _sharded_ivf_pq_search(
                 self.centroids, self.codebooks, self.lists.data, self.lists.ids,
                 self.lists.sizes, b, self.mesh, k, nprobe, g, self.metric,
@@ -838,7 +845,8 @@ class ShardedIVFPQIndex(IVFPQIndex):
                 self, q, k, nprobe, group,
                 lambda block, n, bucket: guarded(run_routed, block, n, bucket),
             )
-        return self._search_blocks(q, k, lambda b: guarded(run_masked, b))
+        return self._search_blocks(q, k, lambda b: guarded(run_masked, b),
+                                   block=nb)
 
     def state_dict(self):
         state = super().state_dict()

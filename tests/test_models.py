@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 from distributed_faiss_tpu.models import FlatIndex, IVFFlatIndex, IVFPQIndex
+from distributed_faiss_tpu.models import base
 from distributed_faiss_tpu.models.factory import (
     INDEX_BUILDERS,
     build_index,
@@ -265,3 +266,30 @@ def test_factory_strings():
         build_index(IndexCfg(index_builder_type="nope", dim=16))
     with pytest.raises(RuntimeError):
         build_index(IndexCfg(dim=16))
+
+
+def test_pick_query_block_budget():
+    # tiny payload -> max block; the headline config (cap=512, d=128 fp32
+    # gather) must allow the full 1024 block the relay-latency fix relies on
+    assert base.pick_query_block(512 * 128 * 4) == base.MAX_QUERY_BLOCK
+    # 4 MB/query (ivf_simple's huge-cap lists) -> pinned at the 256 floor
+    assert base.pick_query_block(8192 * 128 * 4) == 256
+    # block * payload always fits the budget (or is the floor)
+    for b in (1, 10_000, 1 << 20, 1 << 24):
+        blk = base.pick_query_block(b)
+        assert blk == 256 or blk * b <= base._QUERY_PAYLOAD_BUDGET
+
+
+def test_search_results_independent_of_block(rng):
+    # a >256-query batch crosses block boundaries; results must equal the
+    # per-row searches regardless of how the batch is blocked
+    x = rng.standard_normal((2000, 16)).astype(np.float32)
+    q = rng.standard_normal((300, 16)).astype(np.float32)
+    idx = IVFFlatIndex(16, 8, "l2", kmeans_iters=4)
+    idx.train(x)
+    idx.add(x)
+    idx.set_nprobe(8)  # exhaustive -> exact, order-deterministic
+    d_all, i_all = idx.search(q, 5)
+    d_one, i_one = idx.search(q[:1], 5)
+    np.testing.assert_array_equal(i_all[:1], i_one)
+    np.testing.assert_allclose(d_all[:1], d_one, rtol=1e-5)
