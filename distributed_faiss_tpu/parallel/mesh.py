@@ -844,6 +844,7 @@ class ShardedIVFPQIndex(IVFPQIndex):
             return _routed_search_blocks(
                 self, q, k, nprobe, group,
                 lambda block, n, bucket: guarded(run_routed, block, n, bucket),
+                local_k=adc_k or k,
             )
         return self._search_blocks(q, k, lambda b: guarded(run_masked, b),
                                    block=nb)
@@ -1163,7 +1164,27 @@ def _sharded_ivf_pq_search_routed(centroids, codebooks, list_codes, list_ids,
               list_codes, list_ids, list_sizes)
 
 
-def _routed_search_blocks(index, q, k: int, nprobe: int, group: int, call):
+def _routed_block_size(nprobe: int, S: int, group: int, slack: float,
+                       local_k: int, budget: int = 256 * 1024 * 1024) -> int:
+    """Largest query block whose routed per-chip transients fit the budget.
+
+    Unlike the gather-based modes (bounded by a fixed (group, cap, d)
+    score block), routed transients scale with the query block through
+    pair_bucket: the qmerge stage broadcasts (QB=16, pair_bucket, kk)
+    masked value/id(/pos) arrays per scan step, plus the (pair_bucket, kk)
+    scan accumulators. Estimate = 3 arrays * 4 bytes * pair_bucket * kk *
+    (QB + 1), evaluated at the bucket the block would start with."""
+    block = base.MAX_QUERY_BLOCK
+    while block > 256:
+        bucket = routed_pair_bucket(block, nprobe, S, group, slack)
+        if 3 * 4 * bucket * local_k * (16 + 1) <= budget:
+            break
+        block //= 2
+    return block
+
+
+def _routed_search_blocks(index, q, k: int, nprobe: int, group: int, call,
+                          local_k: int = None):
     """Shared block-loop driver for probe-routed searches.
 
     ``call(block, nq_real, bucket) -> (vals, ids, dropped)``. Handles query
@@ -1182,7 +1203,12 @@ def _routed_search_blocks(index, q, k: int, nprobe: int, group: int, call):
     out_s = np.empty((nq, k), np.float32)
     out_i = np.empty((nq, k), np.int64)
     slack = float(getattr(index, "_routed_slack", 2.0))
-    for s0, n, block in base.query_blocks(q):
+    # serving is launch-bound on the relay (see base.pick_query_block), so
+    # take the largest block whose routed transients fit the byte budget —
+    # they scale with the block through pair_bucket (see _routed_block_size)
+    nb = _routed_block_size(nprobe, S, group, slack,
+                            local_k if local_k is not None else k)
+    for s0, n, block in base.query_blocks(q, nb):
         bq = block.shape[0]
         # every pair on one chip is the worst case: a bucket this big
         # cannot drop, so the resize loop below terminates
