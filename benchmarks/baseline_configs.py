@@ -105,20 +105,30 @@ def run_model_config(name, index, metric, n, d, n_clusters, train_n, nprobe, rng
     make_lowrank_corpus)."""
     from distributed_faiss_tpu.models.flat import FlatIndex
 
+    def note(msg):
+        # phase progress on stderr: an unattended hardware run must not be
+        # a black box for an hour (relay launches can degrade to seconds)
+        print(f"[{name}] {time.strftime('%H:%M:%S')} {msg}", file=sys.stderr, flush=True)
+
     if corpus is None:
         centers = rng.standard_normal((n_clusters, d)).astype(np.float32) * 4.0
         corpus = lambda nn: clustered(rng, nn, d, centers)
     x = corpus(n)
     q = corpus(nq)
+    note(f"corpus ready: n={n} d={d}")
 
     t0 = time.time()
     index.train(x[:train_n])
+    note(f"train done in {time.time() - t0:.1f}s")
+    t_add = time.time()
     index.add(x)
     build_s = time.time() - t0
+    note(f"add done in {time.time() - t_add:.1f}s")
 
     exact = FlatIndex(d, metric)
     exact.add(x)
     _, gt = exact.search(q[:128], k)
+    note("ground truth ready")
 
     def recall_at(np_):
         index.set_nprobe(np_)
@@ -130,6 +140,7 @@ def run_model_config(name, index, metric, n, d, n_clusters, train_n, nprobe, rng
         while nprobe <= n_clusters:
             rec = recall_at(nprobe)
             measured_at = nprobe
+            note(f"sweep nprobe={nprobe}: recall@{k}={rec:.4f}")
             if rec >= sweep_to_recall:
                 break
             nprobe *= 2
@@ -139,8 +150,10 @@ def run_model_config(name, index, metric, n, d, n_clusters, train_n, nprobe, rng
         index.set_nprobe(nprobe)
     else:
         rec = recall_at(nprobe)
+    note(f"measuring qps at nprobe={nprobe}")
     qps = measure_qps(lambda qq, kk: index.search(qq, kk), q, k)
     cpu_qps = cpu_exact_qps(x, q[:32], k, metric)
+    note("done")
     return {
         "config": name,
         "n": n, "dim": d, "nprobe": nprobe,

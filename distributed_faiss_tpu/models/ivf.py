@@ -285,8 +285,8 @@ class _IVFBase(base.TpuIndex):
         nq = q.shape[0]
         out_s = np.empty((nq, k), np.float32)
         out_i = np.empty((nq, k), np.int64)
-        for s, n, block in base.query_blocks(np.asarray(q, np.float32), block):
-            vals, ids = fn(jnp.asarray(block))
+        for s, n, chunk in base.query_blocks(np.asarray(q, np.float32), block):
+            vals, ids = fn(jnp.asarray(chunk))
             out_s[s : s + n] = np.asarray(vals)[:n]
             out_i[s : s + n] = np.asarray(ids)[:n]
         return base.finalize_results(out_s, out_i, self.metric)
