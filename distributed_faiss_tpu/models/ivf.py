@@ -242,6 +242,14 @@ class _IVFBase(base.TpuIndex):
             return None
         return np.asarray(self.centroids)
 
+    def get_assignments(self) -> np.ndarray:
+        """Coarse-list assignment of every added row, in insertion order.
+
+        Public counterpart of get_centroids for tooling that needs the
+        host-side inverted-list structure (e.g. the CPU-IVF baseline in
+        benchmarks/baseline_configs.py)."""
+        return self._host_assign_array()
+
     def _assign_host(self, x: np.ndarray, chunk: int = None) -> np.ndarray:
         # bound the (chunk, nlist) fp32 score block — a fixed chunk would
         # blow up at the 65k/262k centroid tiers
