@@ -219,6 +219,13 @@ if __name__ == "__main__":
     # The axon PJRT plugin only registers when cwd is the repo root; the
     # driver may invoke this file from anywhere.
     os.chdir(os.path.dirname(os.path.abspath(__file__)) or ".")
+    # persistent executable cache: repeated driver runs skip compiles
+    # (no-op if the active backend ignores it)
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     if os.environ.get("BENCH_CHILD") == "1":
         main()
     else:

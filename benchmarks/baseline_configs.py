@@ -355,6 +355,15 @@ def main():
     ap.add_argument("--small", action="store_true", help="CPU-sized corpora")
     ap.add_argument("--config", choices=sorted(CONFIGS), default=None)
     args = ap.parse_args()
+    # persistent executable cache: a re-run of the same config pays zero
+    # compiles (the relay's remote-compile latency dominates sweep cost;
+    # harmless no-op if the active backend ignores the cache)
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"),
+    )
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     rng = np.random.default_rng(0)
     names = [args.config] if args.config else list(CONFIGS)
     for name in names:
