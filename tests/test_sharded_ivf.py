@@ -413,3 +413,29 @@ def test_routed_bucket_auto_resize_under_skew(rng, caplog):
     recall = np.mean([len(set(I[i]) & set(gt[i])) / 10 for i in range(len(q))])
     assert recall > 0.995, recall
     assert idx._routed_slack > 2.0
+
+
+def test_large_query_batch_sharded_modes(rng):
+    """A few-hundred-query batch (the launch-bound serving regime the
+    block sizing targets) through both sharded modes: full probe ==
+    brute force, and routed == masked at partial probe."""
+    x = rng.standard_normal((1200, 8)).astype(np.float32)
+    q = rng.standard_normal((300, 8)).astype(np.float32)
+    masked = ShardedIVFFlatIndex(8, 8, "l2")
+    masked.train(x[:600])
+    masked.add(x)
+    masked.set_nprobe(8)
+    D, I = masked.search(q, 5)
+    np.testing.assert_array_equal(I, brute_ids(q, x, 5, "l2"))
+
+    routed = ShardedIVFFlatIndex(8, 8, "l2", probe_routing=True)
+    routed.centroids = masked.centroids
+    routed.lists = masked.lists
+    routed._host_rows, routed._host_assign = masked._host_rows, masked._host_assign
+    routed._n = masked._n
+    routed.set_nprobe(3)
+    masked.set_nprobe(3)
+    Dm, Im = masked.search(q, 5)
+    Dr, Ir = routed.search(q, 5)
+    np.testing.assert_array_equal(Im, Ir)
+    np.testing.assert_allclose(Dm, Dr, rtol=1e-3, atol=1e-3)
