@@ -241,7 +241,7 @@ def run_ivf_simple(rng, small):
                             min(n, 10_000), 12, rng)
 
 
-def run_knnlm(rng, small):
+def run_knnlm(rng, small, opq=False):
     from distributed_faiss_tpu.models.ivf import IVFPQIndex
     from distributed_faiss_tpu.ops.adc_pallas import on_tpu
 
@@ -258,39 +258,26 @@ def run_knnlm(rng, small):
     # refine keeps final scores exact.
     idx = IVFPQIndex(d, nlist, m=m, metric="l2", kmeans_iters=8, pq_iters=10,
                      refine_k_factor=16, use_pallas=on_chip, adc_lut_bf16=on_chip)
+    name = "knnlm"
+    if opq:
+        # OPQ balances per-subspace energy before PQ, which matters exactly
+        # in the low-intrinsic-dim regime the corpus models — the rotation
+        # spreads the r informative directions across all m subspaces
+        from distributed_faiss_tpu.models.pretransform import PreTransformIndex
+
+        idx = PreTransformIndex(idx, d, opq_m=m, opq_iters=8)
+        name = "knnlm-opq"
     # kNN-LM keys are low-intrinsic-dim (see make_lowrank_corpus); 2x latent
     # clusters vs index cells so data clusters != index cells
     gen = make_lowrank_corpus(rng, d, r=max(d // 12, 8), n_latent_clusters=2 * nlist)
-    return run_model_config("knnlm", idx, "l2", n, d, nlist,
+    return run_model_config(name, idx, "l2", n, d, nlist,
                             min(n, 100_000), max(nlist // 16, 8), rng,
                             nq=128 if small else 512, sweep_to_recall=0.95,
                             corpus=gen)
 
 
 def run_knnlm_opq(rng, small):
-    """knnlm with an OPQ rotation in front (factory extra ``opq=True``).
-
-    OPQ balances per-subspace energy before PQ, which matters exactly in
-    the low-intrinsic-dim regime the corpus models — the rotation spreads
-    the r informative directions across all m subspaces."""
-    from distributed_faiss_tpu.models.ivf import IVFPQIndex
-    from distributed_faiss_tpu.models.pretransform import PreTransformIndex
-    from distributed_faiss_tpu.ops.adc_pallas import on_tpu
-
-    n = 20_000 if small else 500_000
-    nlist = 128 if small else 4096
-    m = 16 if small else 64
-    d = 256 if small else 768
-    on_chip = on_tpu()
-    inner = IVFPQIndex(d, nlist, m=m, metric="l2", kmeans_iters=8, pq_iters=10,
-                       refine_k_factor=16, use_pallas=on_chip, adc_lut_bf16=on_chip)
-    idx = PreTransformIndex(inner, d, opq_m=m, opq_iters=8)
-    gen = make_lowrank_corpus(rng, d, r=max(d // 12, 8), n_latent_clusters=2 * nlist)
-    row = run_model_config("knnlm-opq", idx, "l2", n, d, nlist,
-                           min(n, 100_000), max(nlist // 16, 8), rng,
-                           nq=128 if small else 512, sweep_to_recall=0.95,
-                           corpus=gen)
-    return row
+    return run_knnlm(rng, small, opq=True)
 
 
 def run_ivfsq(rng, small):

@@ -31,6 +31,7 @@ from typing import List, Optional, Tuple, Union
 import numpy as np
 
 from distributed_faiss_tpu.models.factory import build_index, index_from_state_dict
+from distributed_faiss_tpu.utils.batching import SearchBatcher
 from distributed_faiss_tpu.utils.config import IndexCfg
 from distributed_faiss_tpu.utils.serialization import load_state, save_state
 from distributed_faiss_tpu.utils.state import IndexState
@@ -74,6 +75,14 @@ class Index:
 
         self.index_save_time = time.time()
         self.index_saved_size = 0
+
+        # concurrent searches coalesce into shared device launches
+        # (launch-bound serving — utils/batching.py); window 0 = natural
+        # batching only, no added latency
+        self._batcher = SearchBatcher(
+            self._device_search,
+            window_ms=float(cfg.extra.get("batch_window_ms", 0.0)),
+        )
 
         if cfg.save_interval_sec > 0:
             self._run_save_watcher()
@@ -256,18 +265,33 @@ class Index:
 
     # ------------------------------------------------------------------ query
 
-    def search(
-        self, query_batch: np.ndarray, top_k: int = 100, return_embeddings: bool = False
-    ) -> Tuple[np.ndarray, List[List[object]], Optional[List[List[np.ndarray]]]]:
+    def _device_search(self, query_batch: np.ndarray, top_k: int):
+        """The locked device launch behind the batcher: one in-flight
+        search per index (reference rationale at index.py:246-252; the
+        lock also serializes against add/growth)."""
         with self.index_lock:
             if self.state != IndexState.TRAINED:
                 raise RuntimeError(f"Server index is not trained. state: {self.state}")
-            # one in-flight device search per index (reference rationale at
-            # index.py:246-252; here it also serializes against add/growth)
-            query_batch = np.asarray(query_batch, np.float32)
-            scores, indexes = self.tpu_index.search(query_batch, top_k)
-            embs = None
-            if return_embeddings:
+            return self.tpu_index.search(query_batch, top_k)
+
+    def search(
+        self, query_batch: np.ndarray, top_k: int = 100, return_embeddings: bool = False
+    ) -> Tuple[np.ndarray, List[List[object]], Optional[List[List[np.ndarray]]]]:
+        query_batch = np.asarray(query_batch, np.float32)
+        embs = None
+        if not return_embeddings:
+            # hot path: concurrent callers share device launches (state
+            # re-checked under the lock inside _device_search)
+            scores, indexes = self._batcher.search(query_batch, top_k)
+        else:
+            # embeddings must be reconstructed from the SAME index state
+            # that produced the ids, so this path stays atomic under
+            # index_lock instead of riding the batcher
+            with self.index_lock:
+                if self.state != IndexState.TRAINED:
+                    raise RuntimeError(
+                        f"Server index is not trained. state: {self.state}")
+                scores, indexes = self.tpu_index.search(query_batch, top_k)
                 flat = indexes.reshape(-1)
                 if self.tpu_index.ntotal == 0:
                     # trained-but-empty window: all ids are -1

@@ -1,0 +1,205 @@
+"""SearchBatcher: concurrent searches coalesce into shared launches with
+identical results (utils/batching.py; no reference analog — FAISS
+searches there serialize one-launch-per-RPC under index_lock)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.utils.batching import SearchBatcher
+
+
+def brute(q, x, k):
+    d2 = ((q[:, None, :] - x[None]) ** 2).sum(2)
+    ids = np.argsort(d2, axis=1)[:, :k]
+    return np.take_along_axis(d2, ids, axis=1), ids
+
+
+def make_runner(x, counter=None, delay=0.0):
+    def run(q, k):
+        if counter is not None:
+            counter.append(q.shape[0])
+        if delay:
+            time.sleep(delay)
+        return brute(q, x, k)
+    return run
+
+
+def test_batched_results_equal_individual(rng):
+    x = rng.standard_normal((500, 8)).astype(np.float32)
+    b = SearchBatcher(make_runner(x))
+    qs = [rng.standard_normal((3, 8)).astype(np.float32) for _ in range(16)]
+    want = [brute(q, x, 4) for q in qs]
+    got = [None] * 16
+    errs = []
+
+    def worker(i):
+        try:
+            got[i] = b.search(qs[i], 4)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    for (ws, wi), (gs, gi) in zip(want, got):
+        np.testing.assert_array_equal(wi, gi)
+        np.testing.assert_allclose(ws, gs, rtol=1e-5)
+
+
+def test_window_coalesces_concurrent_callers(rng):
+    """With a wait window, followers that arrive during the leader's wait
+    ride the leader's launch: far fewer underlying calls than callers."""
+    x = rng.standard_normal((200, 4)).astype(np.float32)
+    calls = []
+    b = SearchBatcher(make_runner(x, counter=calls), window_ms=150)
+    start = threading.Barrier(8)
+
+    def worker():
+        start.wait()
+        b.search(np.zeros((2, 4), np.float32), 3)
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # all 8 callers were served by at most a few launches (typically 1-2);
+    # without coalescing there would be exactly 8
+    assert len(calls) < 8
+    assert sum(calls) == 16  # every row searched exactly once
+
+
+def test_mixed_k_grouping(rng):
+    x = rng.standard_normal((100, 4)).astype(np.float32)
+    b = SearchBatcher(make_runner(x), window_ms=50)
+    out = {}
+
+    def worker(i, k):
+        out[(i, k)] = b.search(np.full((1, 4), i, np.float32), k)
+
+    ts = [threading.Thread(target=worker, args=(i, k))
+          for i, k in enumerate([2, 5, 2, 5, 2])]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for (i, k), (s, ids) in out.items():
+        assert ids.shape == (1, k)
+        ws, wi = brute(np.full((1, 4), i, np.float32), x, k)
+        np.testing.assert_array_equal(wi, ids)
+
+
+def test_error_propagates_to_all_group_members():
+    def run(q, k):
+        raise ValueError("device on fire")
+
+    b = SearchBatcher(run, window_ms=50)
+    errs = []
+
+    def worker():
+        try:
+            b.search(np.zeros((1, 4), np.float32), 3)
+        except ValueError as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(errs) == 4
+    # batcher is not wedged afterwards
+    with pytest.raises(ValueError):
+        b.search(np.zeros((1, 4), np.float32), 3)
+
+
+def test_engine_concurrent_search_equality(rng):
+    """Engine-level: concurrent searches through the batcher return the
+    same (scores, metadata) as sequential ones."""
+    from distributed_faiss_tpu.engine import Index
+    from distributed_faiss_tpu.utils.config import IndexCfg
+
+    x = rng.standard_normal((400, 16)).astype(np.float32)
+    cfg = IndexCfg(index_builder_type="flat", dim=16, metric="l2", train_num=1,
+                   batch_window_ms=30)
+    idx = Index(cfg)
+    idx.add_batch(x, list(range(400)), train_async_if_triggered=False)
+    idx.train()
+    deadline = time.time() + 60
+    from distributed_faiss_tpu.utils.state import IndexState
+    while idx.get_state() != IndexState.TRAINED:
+        assert time.time() < deadline
+        time.sleep(0.05)
+
+    want = [idx.search(x[i:i + 2], 3) for i in range(0, 20, 2)]
+    got = [None] * 10
+
+    def worker(j):
+        got[j] = idx.search(x[2 * j:2 * j + 2], 3)
+
+    ts = [threading.Thread(target=worker, args=(j,)) for j in range(10)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for (ws, wm, _), (gs, gm, _) in zip(want, got):
+        np.testing.assert_allclose(ws, gs, rtol=1e-5)
+        assert wm == gm
+
+
+def test_bad_dim_caller_fails_alone(rng):
+    """Fault isolation: a wrong-dim query shares no group with valid ones."""
+    x = rng.standard_normal((100, 8)).astype(np.float32)
+    b = SearchBatcher(make_runner(x), window_ms=60)
+    results, errs = {}, {}
+
+    def good(i):
+        results[i] = b.search(rng.standard_normal((2, 8)).astype(np.float32), 3)
+
+    def bad():
+        try:
+            b.search(np.zeros((2, 5), np.float32), 3)  # wrong dim
+        except Exception as e:
+            errs["bad"] = e
+
+    ts = [threading.Thread(target=good, args=(i,)) for i in range(3)]
+    ts.append(threading.Thread(target=bad))
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(results) == 3 and all(v[1].shape == (2, 3) for v in results.values())
+    assert "bad" in errs  # its own group failed (shape mismatch in brute)
+
+    with pytest.raises(ValueError):
+        b.search(np.zeros((4,), np.float32), 3)  # 1-D rejected at entry
+
+
+def test_leadership_handoff_under_load(rng):
+    """With max_rounds=1, a follower arriving during the leader's launch is
+    promoted to leader and still gets served."""
+    x = rng.standard_normal((100, 4)).astype(np.float32)
+    calls = []
+    b = SearchBatcher(make_runner(x, counter=calls, delay=0.15),
+                      window_ms=0, max_rounds=1)
+    got = {}
+
+    def first():
+        got["first"] = b.search(np.zeros((1, 4), np.float32), 3)
+
+    def second():
+        time.sleep(0.05)  # arrive while the leader's launch is in flight
+        got["second"] = b.search(np.ones((1, 4), np.float32), 3)
+
+    t1 = threading.Thread(target=first)
+    t2 = threading.Thread(target=second)
+    t1.start(); t2.start()
+    t1.join(timeout=10); t2.join(timeout=10)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert got["first"][1].shape == (1, 3) and got["second"][1].shape == (1, 3)
