@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""Multi-client serving throughput: dynamic batching vs per-call launches.
+
+Measures aggregate QPS of T concurrent client threads, each issuing
+B-query searches against one engine Index, three ways:
+
+  percall  — each caller drives its own device launch (the reference's
+             serving model: one launch per RPC under index_lock)
+  natural  — the SearchBatcher with window 0 (callers arriving while a
+             launch is in flight coalesce into the next one)
+  window   — SearchBatcher with a small wait window (leader waits
+             window_ms for followers before launching)
+
+On a launch-bound backend (the TPU relay: ~66 ms/dispatch —
+benchmarks/profile_ivf.py) natural/window batching multiplies multi-
+client QPS; on CPU the dispatch floor is tiny so the three converge.
+
+Prints one JSON line per mode.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_mode(idx, mode, queries, n_threads, reps, k=10):
+    """Aggregate QPS of n_threads concurrent callers."""
+    from distributed_faiss_tpu.utils.batching import SearchBatcher
+
+    if mode == "percall":
+        search = idx._device_search
+    elif mode == "natural":
+        search = SearchBatcher(idx._device_search, window_ms=0).search
+    else:
+        search = SearchBatcher(idx._device_search, window_ms=3).search
+
+    barrier = threading.Barrier(n_threads + 1)
+    errs = []
+
+    def client(tid):
+        q = queries[tid]
+        barrier.wait()
+        try:
+            for _ in range(reps):
+                search(q, k)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=client, args=(t,)) for t in range(n_threads)]
+    for t in ts:
+        t.start()
+    barrier.wait()
+    t0 = time.time()
+    for t in ts:
+        t.join()
+    dt = time.time() - t0
+    assert not errs, errs[:1]
+    total = n_threads * reps * queries[0].shape[0]
+    return total / dt
+
+
+def main():
+    import jax
+
+    from distributed_faiss_tpu.engine import Index
+    from distributed_faiss_tpu.utils.config import IndexCfg
+    from distributed_faiss_tpu.utils.state import IndexState
+
+    small = os.environ.get("BENCH_SMALL") == "1"
+    n = 50_000 if small else 500_000
+    d, k = 128, 10
+    n_threads, batch, reps = 8, 32, 4 if small else 8
+
+    rng = np.random.default_rng(0)
+    centers = rng.standard_normal((256, d)).astype(np.float32) * 4.0
+    a = rng.integers(0, 256, n)
+    x = (centers[a] + rng.standard_normal((n, d))).astype(np.float32)
+
+    cfg = IndexCfg(index_builder_type="ivfsq", dim=d, metric="l2",
+                   train_num=min(n, 100_000), centroids=256, nprobe=4)
+    idx = Index(cfg)
+    idx.add_batch(x, list(range(n)), train_async_if_triggered=False)
+    idx.train()
+    deadline = time.time() + 1800
+    while idx.get_state() != IndexState.TRAINED:
+        assert time.time() < deadline, "train timed out"
+        time.sleep(0.5)
+
+    queries = [
+        (centers[rng.integers(0, 256, batch)]
+         + rng.standard_normal((batch, d))).astype(np.float32)
+        for _ in range(n_threads)
+    ]
+    idx.search(queries[0], k)  # warm the jit cache
+
+    backend = jax.devices()[0].platform
+    for mode in ("percall", "natural", "window"):
+        qps = run_mode(idx, mode, queries, n_threads, reps, k)
+        print(json.dumps({
+            "case": f"concurrency_{mode}", "backend": backend,
+            "threads": n_threads, "batch": batch, "qps": round(qps, 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
