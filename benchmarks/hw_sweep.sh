@@ -1,0 +1,50 @@
+#!/bin/bash
+# Prioritized hardware sweep: run EVERYTHING outstanding against the TPU,
+# most important first, so a mid-run relay death loses the least.
+#
+#   bash benchmarks/hw_sweep.sh [logfile]
+#
+# Priority rationale (see benchmarks/RESULTS.md):
+#   1. knnlm        — the flagship recall@0.95 row, still unmeasured at full size
+#   2. sharded      — the only BASELINE config with no hardware row at all
+#   3. bench.py     — the driver headline (re-check after the query-block fix)
+#   4. flat/ivfsq/ivf_simple — refresh (rows predate segmented top-k + blocks)
+#   5. serving_concurrency   — the dynamic-batching win is launch-bound-only
+#   6. knnlm-opq    — OPQ ablation of the flagship
+#   7. tpu_validate — pallas parity re-check (already passed once on v5e)
+#
+# The relay dies unannounced (three rounds running); every step is
+# timeout-bounded and the log records per-phase progress.
+
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-/tmp/hw_sweep.log}"
+
+note() { echo "=== $1 $(date +%H:%M:%S) ===" | tee -a "$LOG"; }
+
+note "probe"
+if ! timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" \
+    >> "$LOG" 2>&1; then
+  note "NO TPU (probe failed) — aborting sweep"
+  exit 1
+fi
+
+note "1/7 knnlm"
+timeout 5400 python benchmarks/baseline_configs.py --config knnlm >> "$LOG" 2>&1
+note "2/7 sharded"
+timeout 3600 python benchmarks/baseline_configs.py --config sharded >> "$LOG" 2>&1
+note "3/7 bench.py headline"
+timeout 3600 python bench.py >> "$LOG" 2>&1
+note "4a/7 flat"
+timeout 3600 python benchmarks/baseline_configs.py --config flat >> "$LOG" 2>&1
+note "4b/7 ivfsq"
+timeout 3600 python benchmarks/baseline_configs.py --config ivfsq >> "$LOG" 2>&1
+note "4c/7 ivf_simple"
+timeout 3600 python benchmarks/baseline_configs.py --config ivf_simple >> "$LOG" 2>&1
+note "5/7 serving concurrency"
+timeout 3600 python benchmarks/serving_concurrency.py >> "$LOG" 2>&1
+note "6/7 knnlm-opq"
+timeout 5400 python benchmarks/baseline_configs.py --config knnlm-opq >> "$LOG" 2>&1
+note "7/7 pallas validate"
+timeout 3600 python benchmarks/tpu_validate.py >> "$LOG" 2>&1
+note "SWEEP DONE"
