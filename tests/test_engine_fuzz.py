@@ -1,0 +1,91 @@
+"""Randomized op-sequence fuzz of the engine state machine.
+
+Drives random interleavings of the public engine surface (add / train /
+search / save / load / drop) and asserts the invariants the reference's
+state machine promises (index.py:138-343): state only moves through the
+lattice, search works iff TRAINED, ntotal-vs-metadata accounting stays
+positional, and a save/load round-trip at any point reproduces state.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.engine import Index
+from distributed_faiss_tpu.utils.config import IndexCfg
+from distributed_faiss_tpu.utils.state import IndexState
+
+
+def wait_trained(idx, timeout=60):
+    deadline = time.time() + timeout
+    while idx.get_state() not in (IndexState.TRAINED, IndexState.NOT_TRAINED):
+        assert time.time() < deadline, f"stuck in {idx.get_state()}"
+        time.sleep(0.02)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_engine_random_op_sequence(seed, tmp_path):
+    rng = np.random.default_rng(seed)
+    d = 8
+    cfg = IndexCfg(index_builder_type="ivf_simple", dim=d, metric="l2",
+                   train_num=150, centroids=4, nprobe=4)
+    idx = Index(cfg)
+    total = 0
+    storage = str(tmp_path / f"fuzz{seed}")
+
+    for step in range(30):
+        op = rng.choice(["add", "search", "save_load", "state"])
+        if op == "add":
+            n = int(rng.integers(1, 80))
+            x = rng.standard_normal((n, d)).astype(np.float32)
+            idx.add_batch(x, list(range(total, total + n)))
+            total += n
+            if total >= cfg.train_num:
+                # settle to TRAINED so later ops see a deterministic state
+                deadline = time.time() + 60
+                while idx.get_state() != IndexState.TRAINED:
+                    assert time.time() < deadline
+                    time.sleep(0.02)
+            else:
+                assert idx.get_state() == IndexState.NOT_TRAINED
+        elif op == "search":
+            q = rng.standard_normal((2, d)).astype(np.float32)
+            if idx.get_state() == IndexState.TRAINED:
+                scores, meta, _ = idx.search(q, 3)
+                assert scores.shape == (2, 3) and len(meta) == 2
+                # positional metadata: every non-None hit is a real id
+                for row in meta:
+                    for m in row:
+                        assert m is None or 0 <= m < total
+            else:
+                with pytest.raises(RuntimeError):
+                    idx.search(q, 3)
+        elif op == "save_load" and idx.get_state() == IndexState.TRAINED:
+            idx.cfg.index_storage_dir = storage
+            idx.save()
+            idx2 = Index.from_storage_dir(storage)
+            wait_trained(idx2)
+            assert idx2.get_state() == IndexState.TRAINED
+            buf, nidx = idx.get_idx_data_num()
+            buf2, nidx2 = idx2.get_idx_data_num()
+            assert buf + nidx == buf2 + nidx2 == total
+            q = rng.standard_normal((1, d)).astype(np.float32)
+            s1, m1, _ = idx.search(q, 3)
+            s2, m2, _ = idx2.search(q, 3)
+            np.testing.assert_allclose(s1, s2, rtol=1e-5)
+            assert m1 == m2
+        else:
+            st = idx.get_state()
+            assert st in (IndexState.NOT_TRAINED, IndexState.TRAINING,
+                          IndexState.ADD, IndexState.TRAINED)
+            if total >= cfg.train_num:
+                # the async train thread may not have flipped the state yet
+                # (NOT_TRAINED -> TRAINING is itself asynchronous), so poll
+                # to TRAINED rather than treating NOT_TRAINED as terminal
+                deadline = time.time() + 60
+                while idx.get_state() != IndexState.TRAINED:
+                    assert time.time() < deadline, "threshold crossed but never trained"
+                    time.sleep(0.02)
+            buf, nidx = idx.get_idx_data_num()
+            assert buf + nidx == total
