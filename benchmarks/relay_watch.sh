@@ -1,0 +1,33 @@
+#!/bin/bash
+# Watch for the TPU relay to come alive, then immediately run the
+# prioritized hardware sweep (benchmarks/hw_sweep.sh). The relay has
+# been dead at round start and alive for a ~1h window mid-round in
+# every round so far; this loop makes sure no alive-minute is wasted.
+#
+#   bash benchmarks/relay_watch.sh [max_wait_seconds]
+#
+# Exits 0 after a completed sweep, 2 if the wait budget expires.
+
+set -u
+cd "$(dirname "$0")/.."
+MAX_WAIT="${1:-28800}"   # default: keep watching for 8h
+LOG=/tmp/relay_watch.log
+START=$(date +%s)
+
+echo "watch start $(date +%H:%M:%S)" | tee -a "$LOG"
+while :; do
+  now=$(date +%s)
+  if (( now - START > MAX_WAIT )); then
+    echo "watch budget expired $(date +%H:%M:%S)" | tee -a "$LOG"
+    exit 2
+  fi
+  if timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" \
+      >> "$LOG" 2>&1; then
+    echo "RELAY ALIVE $(date +%H:%M:%S) — launching sweep" | tee -a "$LOG"
+    bash benchmarks/hw_sweep.sh /tmp/hw_sweep.log 2>&1 | tee -a "$LOG"
+    echo "SWEEP EXITED $(date +%H:%M:%S)" | tee -a "$LOG"
+    exit 0
+  fi
+  echo "relay dead $(date +%H:%M:%S), retry in 180s" >> "$LOG"
+  sleep 180
+done
