@@ -22,29 +22,49 @@ LOG="${1:-/tmp/hw_sweep.log}"
 
 note() { echo "=== $1 $(date +%H:%M:%S) ===" | tee -a "$LOG"; }
 
-note "probe"
-if ! timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" \
-    >> "$LOG" 2>&1; then
-  note "NO TPU (probe failed) — aborting sweep"
-  exit 1
-fi
+alive() {
+  timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" \
+    >> "$LOG" 2>&1
+}
 
-note "1/7 knnlm"
-timeout 5400 python benchmarks/baseline_configs.py --config knnlm >> "$LOG" 2>&1
-note "2/7 sharded"
-timeout 3600 python benchmarks/baseline_configs.py --config sharded >> "$LOG" 2>&1
-note "3/7 bench.py headline"
-timeout 3600 python bench.py >> "$LOG" 2>&1
-note "4a/7 flat"
-timeout 3600 python benchmarks/baseline_configs.py --config flat >> "$LOG" 2>&1
-note "4b/7 ivfsq"
-timeout 3600 python benchmarks/baseline_configs.py --config ivfsq >> "$LOG" 2>&1
-note "4c/7 ivf_simple"
-timeout 3600 python benchmarks/baseline_configs.py --config ivf_simple >> "$LOG" 2>&1
-note "5/7 serving concurrency"
-timeout 3600 python benchmarks/serving_concurrency.py >> "$LOG" 2>&1
-note "6/7 knnlm-opq"
-timeout 5400 python benchmarks/baseline_configs.py --config knnlm-opq >> "$LOG" 2>&1
-note "7/7 pallas validate"
-timeout 3600 python benchmarks/tpu_validate.py >> "$LOG" 2>&1
+# Completed steps leave a marker so a sweep revived after a mid-run relay
+# death resumes at the first unmeasured step instead of re-burning the next
+# alive window on steps already measured. rm -rf "$DONE" to force a full
+# re-run (e.g. after a code change that invalidates earlier rows).
+DONE=/tmp/hw_sweep.done
+mkdir -p "$DONE"
+
+# step <name> <timeout_s> <cmd...>: skip if already completed; re-probe
+# liveness before each step so a mid-sweep relay death costs at most one
+# step's timeout, not the sum of every remaining step's; exit 1 tells
+# relay_watch to resume watching.
+step() {
+  local name=$1 to=$2
+  shift 2
+  local marker="$DONE/$(echo "$name" | tr ' /' '__')"
+  if [ -e "$marker" ]; then
+    note "$name SKIPPED (done marker)"
+    return 0
+  fi
+  if ! alive; then
+    note "RELAY DIED before $name — aborting sweep (rc=1)"
+    exit 1
+  fi
+  note "$name"
+  if timeout "$to" "$@" >> "$LOG" 2>&1; then
+    touch "$marker"
+  else
+    note "$name FAILED rc=$?"
+  fi
+}
+
+step "1/7 knnlm"              5400 python benchmarks/baseline_configs.py --config knnlm
+step "2/7 sharded"            3600 python benchmarks/baseline_configs.py --config sharded
+step "3/7 bench.py headline"  3600 python bench.py
+step "4a/7 flat"              3600 python benchmarks/baseline_configs.py --config flat
+step "4b/7 ivfsq"             3600 python benchmarks/baseline_configs.py --config ivfsq
+step "4c/7 ivf_simple"        3600 python benchmarks/baseline_configs.py --config ivf_simple
+step "5/7 serving concurrency" 3600 python benchmarks/serving_concurrency.py
+step "6/7 knnlm-opq"          5400 python benchmarks/baseline_configs.py --config knnlm-opq
+step "7/7 pallas validate"    3600 python benchmarks/tpu_validate.py
 note "SWEEP DONE"

@@ -10,6 +10,16 @@ import time
 
 import numpy as np
 
+# the engine import pulls in jax (already interpreter-preloaded by
+# sitecustomize); steer any lazy backend init away from the TPU relay so
+# this pure-numpy bench can never hang on a dead relay
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 from distributed_faiss_tpu.engine import _MetaStore
 
 

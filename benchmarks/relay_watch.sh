@@ -24,9 +24,15 @@ while :; do
   if timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" \
       >> "$LOG" 2>&1; then
     echo "RELAY ALIVE $(date +%H:%M:%S) — launching sweep" | tee -a "$LOG"
-    bash benchmarks/hw_sweep.sh /tmp/hw_sweep.log 2>&1 | tee -a "$LOG"
-    echo "SWEEP EXITED $(date +%H:%M:%S)" | tee -a "$LOG"
-    exit 0
+    bash benchmarks/hw_sweep.sh /tmp/hw_sweep.log >> "$LOG" 2>&1
+    rc=$?
+    echo "SWEEP EXITED rc=$rc $(date +%H:%M:%S)" | tee -a "$LOG"
+    # a non-zero sweep (relay died between our probe and the sweep's, or
+    # mid-run) must NOT burn the remaining wait budget: the alive window
+    # may recur — keep watching
+    if [ "$rc" -eq 0 ]; then
+      exit 0
+    fi
   fi
   echo "relay dead $(date +%H:%M:%S), retry in 180s" >> "$LOG"
   sleep 180

@@ -48,9 +48,15 @@ class _MetaStore:
     ~100k interpreted ops per 1024-query block at k=100, executed on the
     serving thread. Backing the store with a capacity-doubling object array
     makes the join one vectorized ``take`` and lets ``search`` hold
-    ``buffer_lock`` only long enough to snapshot (array ref, length) — a
-    concurrent ``extend`` allocates a fresh array, so the snapshot stays
-    consistent without the lock.
+    ``buffer_lock`` only long enough to snapshot (array ref, length).
+
+    Why reading the snapshot outside the lock is safe: the store is
+    APPEND-ONLY — ``extend`` writes only slots >= the snapshotted length
+    (in place when capacity suffices; into a fresh array on growth), slots
+    below it are never rewritten, and object-array element access is a
+    GIL-atomic pointer load. Any future mutating API (update/delete of
+    existing slots) would break this invariant and must copy-on-write or
+    move the join back under the lock.
 
     On-disk format is unchanged: persistence goes through ``tolist()`` so
     meta.pkl stays a plain pickled list.
@@ -363,14 +369,20 @@ class Index:
                     rec[flat < 0] = 0.0
                 embs_arr = rec.reshape(indexes.shape + (query_batch.shape[1],))
 
-        # vectorized metadata join: lock held only for the snapshot; a
-        # concurrent extend swaps in a fresh backing array, so reading the
-        # snapshotted one outside the lock is race-free
+        # vectorized metadata join: lock held only for the snapshot; safe
+        # outside the lock because the store is append-only past the
+        # snapshotted length (see _MetaStore docstring)
         with self.buffer_lock:
-            meta_arr, _ = self.id_to_metadata.snapshot()
+            meta_arr, meta_n = self.id_to_metadata.snapshot()
         valid = indexes != -1
+        if valid.any() and int(indexes.max()) >= meta_n:
+            # loud failure on index/metadata desync (e.g. a concurrent
+            # drop_index mid-search) — never serve clipped/stale metadata
+            raise IndexError(
+                f"search returned id {int(indexes.max())} >= metadata size {meta_n}"
+            )
         safe = np.where(valid, indexes, 0)
-        joined = meta_arr.take(safe.ravel(), mode="clip").reshape(indexes.shape)
+        joined = meta_arr.take(safe.ravel()).reshape(indexes.shape)
         joined[~valid] = None
         results_meta = joined.tolist()
         nq, k = indexes.shape

@@ -261,7 +261,11 @@ def finalize_results(scores: np.ndarray, ids: np.ndarray, metric: str):
 
 
 MAX_QUERY_BLOCK = 1024
-_QUERY_PAYLOAD_BUDGET = 512 * 1024 * 1024
+# 2x ivf._GROUP_BYTE_BUDGET: when probe grouping floors at g=1 (one probe's
+# block-payload already exceeds the 128MB group budget), the gather transient
+# equals block * per-probe bytes — this cap bounds that worst case at 256MB
+# instead of letting large-cap/high-dim configs reach 4x the group budget
+_QUERY_PAYLOAD_BUDGET = 256 * 1024 * 1024
 
 
 def pick_query_block(probe_bytes_per_query: int, minimum: int = 256) -> int:
@@ -272,6 +276,13 @@ def pick_query_block(probe_bytes_per_query: int, minimum: int = 256) -> int:
     while the fused search call is nearly flat in block size (133 ms @ 256
     queries vs 139 ms @ 1024), so serving QPS is launch-bound — the block
     should be as large as the gather payload allows, not a fixed 256.
+
+    Combined worst-case transient with probe grouping: if one probe's
+    payload for the chosen block exceeds the group budget, g floors at 1 and
+    the transient is block * probe_bytes <= _QUERY_PAYLOAD_BUDGET (the
+    ``minimum`` floor can still exceed it for extreme per-probe payloads —
+    by construction, a single probe at minimum block that large would not
+    fit any budget).
     """
     block = MAX_QUERY_BLOCK
     while block > minimum and block * probe_bytes_per_query > _QUERY_PAYLOAD_BUDGET:

@@ -83,6 +83,9 @@ def _mask_block(s, ids, sizes):
     return jnp.where(valid & (ids >= 0), s, distance.NEG_INF)
 
 
+# paired bound: base._QUERY_PAYLOAD_BUDGET = 2x this, so even when one
+# probe's block-payload exceeds this budget (g floors at 1) the gather
+# transient stays within 2x, not unbounded
 _GROUP_BYTE_BUDGET = 128 * 1024 * 1024
 
 

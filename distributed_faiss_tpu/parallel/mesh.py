@@ -372,6 +372,7 @@ class ShardedPaddedLists:
         self.payload_shape = tuple(payload_shape)
         self.dtype = dtype
         self.cap = min_cap or self.MIN_CAP
+        self._check_cell_space(self.cap)
         self._data_sharding = NamedSharding(
             mesh, P(*((AXIS,) + (None,) * (1 + len(self.payload_shape))))
         )
@@ -405,10 +406,24 @@ class ShardedPaddedLists:
         out[self.slot_of(np.arange(self.nlist))] = self.sizes_host
         return out
 
+    def _check_cell_space(self, cap: int) -> None:
+        """Scatter positions and the drop sentinel are int32 flat cell
+        addresses over the whole padded space (``nlist_pad * cap``); past
+        int32 they would wrap silently and corrupt foreign lists. Refuse the
+        configuration instead of wrapping."""
+        total = self.nlist_pad * cap
+        if total > np.iinfo(np.int32).max:
+            raise ValueError(
+                f"sharded cell space nlist_pad({self.nlist_pad}) * cap({cap}) "
+                f"= {total} overflows int32 addressing; shard over more chips "
+                f"or split the index (DESIGN.md scale limits)"
+            )
+
     def _grow(self, needed_cap: int):
         newcap = base._next_pow2(needed_cap, self.cap)
         if newcap == self.cap:
             return
+        self._check_cell_space(newcap)
         pad_d = [(0, 0), (0, newcap - self.cap)] + [(0, 0)] * len(self.payload_shape)
         self.data = jax.device_put(jnp.pad(self.data, pad_d), self._data_sharding)
         self.ids = jax.device_put(

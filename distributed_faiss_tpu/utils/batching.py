@@ -130,25 +130,36 @@ class SearchBatcher:
         groups = {}
         for e in batch:
             groups.setdefault((e.k, e.q.shape[1]), []).append(e)
-        for (k, _d), group in groups.items():
-            try:
-                qcat = group[0].q if len(group) == 1 else np.concatenate(
-                    [e.q for e in group], axis=0)
-                scores, ids = self._run(qcat, k)
-                ofs = 0
-                for e in group:
-                    n = e.q.shape[0]
-                    e.scores = scores[ofs:ofs + n]
-                    e.ids = ids[ofs:ofs + n]
-                    ofs += n
-            except Exception as exc:  # propagate to every caller in the group
-                for e in group:
-                    e.error = exc
-            finally:
-                for e in group:
-                    # a BaseException from the launch (KeyboardInterrupt,
-                    # SystemExit) skips both branches above — never wake a
-                    # caller with neither result nor error
+        try:
+            for (k, _d), group in groups.items():
+                try:
+                    qcat = group[0].q if len(group) == 1 else np.concatenate(
+                        [e.q for e in group], axis=0)
+                    scores, ids = self._run(qcat, k)
+                    ofs = 0
+                    for e in group:
+                        n = e.q.shape[0]
+                        e.scores = scores[ofs:ofs + n]
+                        e.ids = ids[ofs:ofs + n]
+                        ofs += n
+                except Exception as exc:  # propagate to every caller in the group
+                    for e in group:
+                        e.error = exc
+                finally:
+                    for e in group:
+                        # a BaseException from the launch (KeyboardInterrupt,
+                        # SystemExit) skips both branches above — never wake a
+                        # caller with neither result nor error
+                        if not e.done:
+                            e.error = RuntimeError("search batch aborted")
+                        e.event.set()
+        finally:
+            # a BaseException mid-iteration reaches the per-group finally of
+            # the FAILING group only; the batch was already popped from
+            # _pending, so entries in groups the loop never reached would
+            # otherwise wait forever — sweep the whole batch
+            for e in batch:
+                if not e.event.is_set():
                     if not e.done:
                         e.error = RuntimeError("search batch aborted")
                     e.event.set()

@@ -119,6 +119,42 @@ def test_error_propagates_to_all_group_members():
         b.search(np.zeros((1, 4), np.float32), 3)
 
 
+def test_base_exception_wakes_every_group(rng):
+    """A BaseException from the launch mid multi-group batch must wake
+    callers in ALL groups of the popped batch, not just the failing one
+    (ADVICE r2: groups the _serve loop never reached would hang forever)."""
+    calls = []
+
+    def run(q, k):
+        calls.append(k)
+        if len(calls) == 1:
+            raise KeyboardInterrupt  # first group's launch dies hard
+        return np.zeros((q.shape[0], k), np.float32), np.zeros((q.shape[0], k), np.int64)
+
+    b = SearchBatcher(run, window_ms=80)
+    results = {}
+
+    def worker(i, k):
+        try:
+            results[i] = ("ok", b.search(np.full((1, 4), i, np.float32), k))
+        except BaseException as e:  # noqa: BLE001 - the test wants the class
+            results[i] = ("err", type(e).__name__)
+
+    # two k-groups coalesced into one batch window; one group's launch
+    # raises KeyboardInterrupt — every caller must still return/raise
+    ts = [threading.Thread(target=worker, args=(i, k))
+          for i, k in enumerate([2, 2, 5, 5])]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in ts), "stranded callers"
+    assert len(results) == 4
+    # the batcher is usable afterwards
+    s, ids = b.search(np.zeros((1, 4), np.float32), 3)
+    assert ids.shape == (1, 3)
+
+
 def test_engine_concurrent_search_equality(rng):
     """Engine-level: concurrent searches through the batcher return the
     same (scores, metadata) as sequential ones."""

@@ -47,6 +47,25 @@ def test_sharded_lists_growth(rng):
     assert sorted(ids[ids >= 0].tolist()) == list(range(64))
 
 
+def test_sharded_lists_int32_cell_space_guard(rng):
+    """nlist_pad * cap past int32 must refuse loudly, not wrap (scatter
+    positions and the drop sentinel are int32 flat cell addresses)."""
+    m = make_mesh()
+    # construction-time guard fires before any device allocation
+    with pytest.raises(ValueError, match="int32"):
+        ShardedPaddedLists(2**26, (4,), np.float32, m, min_cap=64)
+    # growth-time guard: small list count, growth request that would
+    # overflow the flat space; raises before the pad allocates
+    lists = ShardedPaddedLists(8, (2,), np.float32, m, min_cap=8)
+    with pytest.raises(ValueError, match="int32"):
+        lists._grow(2**28 + 1)
+    assert lists.cap == 8  # untouched by the refused growth
+    # a legal append still works after the refusal
+    lists.append(np.zeros(4, np.int64), np.ones((4, 2), np.float32),
+                 np.arange(4, dtype=np.int64))
+    assert lists.ntotal == 4
+
+
 @pytest.mark.parametrize("metric", ["dot", "l2"])
 def test_sharded_ivf_full_probe_exact(rng, metric):
     """nprobe == nlist: sharded IVF must equal brute force exactly."""
