@@ -66,5 +66,7 @@ step "4b/7 ivfsq"             3600 python benchmarks/baseline_configs.py --confi
 step "4c/7 ivf_simple"        3600 python benchmarks/baseline_configs.py --config ivf_simple
 step "5/7 serving concurrency" 3600 python benchmarks/serving_concurrency.py
 step "6/7 knnlm-opq"          5400 python benchmarks/baseline_configs.py --config knnlm-opq
-step "7/7 pallas validate"    3600 python benchmarks/tpu_validate.py
+step "7/9 pallas validate"    3600 python benchmarks/tpu_validate.py
+step "8/9 adc roofline"       3600 python benchmarks/adc_roofline.py
+step "9/9 operating curves"   7200 python benchmarks/operating_curves.py
 note "SWEEP DONE"

@@ -14,8 +14,10 @@ sq8 (int8 affine, dequantize-on-the-fly) — the sq8 variant also serves as
 the exact-search fallback substrate for `hnswsq` until the graph index lands.
 """
 
+import functools
 from typing import Dict
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -28,6 +30,20 @@ _CODEC_DTYPES = {
     "bf16": jnp.bfloat16,
     "sq8": jnp.uint8,
 }
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric", "codec"))
+def _flat_search_fused(q3, data, ntotal, k: int, metric: str, codec: str,
+                       vmin=None, span=None):
+    """Whole multi-block exact scan in ONE device launch (lax.map over
+    (nblocks, block, d) stacked queries — launch-bound serving, see
+    base.pick_query_block)."""
+
+    def body(qb):
+        kwargs = {} if codec != "sq8" else {"codec": "sq8", "vmin": vmin, "span": span}
+        return distance.knn(qb, data, k, metric=metric, ntotal=ntotal, **kwargs)
+
+    return jax.lax.map(body, q3)
 
 
 class FlatIndex(base.TpuIndex):
@@ -78,6 +94,19 @@ class FlatIndex(base.TpuIndex):
         # scan — launch-bound serving wants the largest block that keeps it
         # within budget (see base.pick_query_block)
         nb = base.pick_query_block(65536 * 4)
+        if nq > nb:
+            # multi-block batch: one launch for all blocks (trailing block
+            # padded to full width — extra compute only)
+            nblocks = -(-nq // nb)
+            qp = np.pad(q, ((0, nblocks * nb - nq), (0, 0)))
+            vals, ids = _flat_search_fused(
+                jnp.asarray(qp.reshape(nblocks, nb, -1)), self.store.data,
+                jnp.asarray(self.store.ntotal, jnp.int32), k, self.metric,
+                self.codec, vmin=kwargs.get("vmin"), span=kwargs.get("span"),
+            )
+            out_s = np.asarray(vals).reshape(nblocks * nb, -1)[:nq]
+            out_i = np.asarray(ids).reshape(nblocks * nb, -1)[:nq].astype(np.int64)
+            return base.finalize_results(out_s, out_i, self.metric)
         for s, n, block in base.query_blocks(q, nb):
             vals, ids = distance.knn(
                 block, self.store.data, k, metric=self.metric, ntotal=self.store.ntotal, **kwargs

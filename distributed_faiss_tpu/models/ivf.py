@@ -216,6 +216,50 @@ def _ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_sizes, q,
     return vals, ids
 
 
+@functools.partial(jax.jit, static_argnames=("k", "scan_k", "nprobe", "g", "metric",
+                                             "codec", "refine"))
+def _ivf_flat_search_fused(centroids, list_data, list_ids, list_sizes, refine_data,
+                           q3, k: int, scan_k: int, nprobe: int, g: int,
+                           metric: str, codec: str, refine: bool,
+                           vmin=None, span=None):
+    """Whole multi-block search in ONE device launch.
+
+    q3: (nblocks, block, d). ``lax.map`` runs the per-block program
+    sequentially on device, so the transient-memory budgets sized for one
+    block still hold — but the host pays a single ~66 ms dispatch for the
+    entire batch instead of one per block (launch-bound serving,
+    benchmarks/profile_ivf.py)."""
+
+    def body(qb):
+        vals, ids = _ivf_flat_search(centroids, list_data, list_ids, list_sizes,
+                                     qb, scan_k, nprobe, g, metric, codec,
+                                     vmin, span)
+        if refine:
+            vals, ids = _rerank_exact(refine_data, qb, ids, k, metric)
+        return vals, ids
+
+    return jax.lax.map(body, q3)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "adc_k", "nprobe", "g", "metric",
+                                             "use_pallas", "lut_bf16", "refine"))
+def _ivf_pq_search_fused(centroids, codebooks, list_codes, list_ids, list_sizes,
+                         refine_data, q3, k: int, adc_k: int, nprobe: int, g: int,
+                         metric: str, use_pallas: bool, lut_bf16: bool,
+                         refine: bool):
+    """Multi-block IVF-PQ search in one launch (see _ivf_flat_search_fused)."""
+
+    def body(qb):
+        vals, ids = _ivf_pq_search(centroids, codebooks, list_codes, list_ids,
+                                   list_sizes, qb, adc_k, nprobe, g, metric,
+                                   use_pallas=use_pallas, lut_bf16=lut_bf16)
+        if refine:
+            vals, ids = _rerank_exact(refine_data, qb, ids, k, metric)
+        return vals, ids
+
+    return jax.lax.map(body, q3)
+
+
 class _IVFBase(base.TpuIndex):
     """Shared coarse-quantizer + list bookkeeping for IVF variants."""
 
@@ -292,11 +336,31 @@ class _IVFBase(base.TpuIndex):
             self._host_assign = [np.concatenate(self._host_assign)]
         return self._host_assign[0] if self._host_assign else np.zeros((0,), np.int64)
 
-    def _search_blocks(self, q: np.ndarray, k: int, fn, block: int = 256):
+    def _search_blocks(self, q: np.ndarray, k: int, fn, block: int = 256,
+                       fused_fn=None):
+        """Blocked search driver.
+
+        Default: one device launch per query block (``fn``). When the batch
+        spans multiple blocks and the caller supplies ``fused_fn`` (a
+        callable over (nblocks, block, d) stacked queries), the whole batch
+        runs in ONE launch — on the launch-bound relay that saves
+        (nblocks-1) * ~66 ms per search call. The trailing block is padded
+        to full width inside the fused path (extra compute only, free in
+        the launch-bound regime); jit variants are keyed on nblocks, so
+        offline/bench callers with a stable batch size compile once.
+        """
+        q = np.asarray(q, np.float32)
         nq = q.shape[0]
+        if fused_fn is not None and nq > block:
+            nblocks = -(-nq // block)
+            qp = np.pad(q, ((0, nblocks * block - nq), (0, 0)))
+            vals, ids = fused_fn(jnp.asarray(qp.reshape(nblocks, block, -1)))
+            out_s = np.asarray(vals).reshape(nblocks * block, -1)[:nq]
+            out_i = np.asarray(ids).reshape(nblocks * block, -1)[:nq].astype(np.int64)
+            return base.finalize_results(out_s, out_i, self.metric)
         out_s = np.empty((nq, k), np.float32)
         out_i = np.empty((nq, k), np.int64)
-        for s, n, chunk in base.query_blocks(np.asarray(q, np.float32), block):
+        for s, n, chunk in base.query_blocks(q, block):
             vals, ids = fn(jnp.asarray(chunk))
             out_s[s : s + n] = np.asarray(vals)[:n]
             out_i[s : s + n] = np.asarray(ids)[:n]
@@ -393,7 +457,15 @@ class IVFFlatIndex(_IVFBase):
                 vals, ids = _rerank_exact(self.refine_store.data, b, ids, k, self.metric)
             return vals, ids
 
-        return self._search_blocks(q, k, run, block=nb)
+        def run_fused(q3):
+            return _ivf_flat_search_fused(
+                self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
+                self.refine_store.data if self.refine_k_factor else None,
+                q3, k, scan_k, nprobe, g, self.metric, self.codec,
+                bool(self.refine_k_factor), **extra,
+            )
+
+        return self._search_blocks(q, k, run, block=nb, fused_fn=run_fused)
 
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
         rows = self._host_rows_array()[np.asarray(ids, np.int64)]
@@ -553,7 +625,37 @@ class IVFPQIndex(_IVFBase):
                 vals, ids = _rerank_exact(self.refine_store.data, b, ids, k, self.metric)
             return vals, ids
 
-        return self._search_blocks(q, k, run, block=nb)
+        def adc_fused(q3, with_pallas):
+            return _ivf_pq_search_fused(
+                self.centroids, self.codebooks, self.lists.data, self.lists.ids,
+                self.lists.sizes,
+                self.refine_store.data if self.refine_k_factor else None,
+                q3, k, adc_k, nprobe, g, self.metric,
+                use_pallas=with_pallas,
+                lut_bf16=with_pallas and self.adc_lut_bf16,
+                refine=bool(self.refine_k_factor),
+            )
+
+        def run_fused(q3):
+            # same pallas runtime-fallback protocol as the per-block path
+            with_pallas = self.use_pallas and self._pallas_runtime_ok
+            try:
+                out = adc_fused(q3, with_pallas)
+                jax.block_until_ready(out)
+            except Exception:
+                if not with_pallas:
+                    raise
+                out = adc_fused(q3, False)
+                jax.block_until_ready(out)
+                logger.exception(
+                    "pallas ADC kernel failed on this backend; using the XLA "
+                    "path for the rest of this process (persisted use_pallas "
+                    "intent is unchanged)"
+                )
+                self._pallas_runtime_ok = False
+            return out
+
+        return self._search_blocks(q, k, run, block=nb, fused_fn=run_fused)
 
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
         ids = np.asarray(ids, np.int64)

@@ -173,10 +173,127 @@ def adc_scan_pallas(lut, codes, tile: int = DEFAULT_TILE, interpret: bool = Fals
     return out[:, 0, :L]
 
 
+# ---------------------------------------------------------------- nibble ADC
+#
+# The one-hot kernel's measured bottleneck is the VPU one-hot build: ksub=256
+# stores per code byte feeding an M=1 MXU matmul (416M codes/s on v5e —
+# single-digit % of HBM bw). Decomposing each 8-bit code into two 4-bit
+# nibbles (hi = c >> 4, lo = c & 15) rewrites the LUT lookup as
+#
+#   lut[m, c] = sum_{h, l} LUT2[m, h, l] * (hi==h) * (lo==l)
+#
+# i.e. a 16-wide one-hot on each side instead of 256-wide. Per candidate
+# tile the kernel builds (m*16, tile) hi/lo one-hot planes (full-lane
+# stores, 16x fewer bytes than the 256-wide one-hot), rides the hi side
+# through 8-subspace-chunk (128, 128) dense matmuls against a per-query
+# block-diagonal LUT (built once per query, reused across candidate tiles),
+# and folds the lo side as an elementwise select + sublane reduce:
+#
+#   chunk mc (8 subspaces):  T = B[mc]^T @ OhT     (128, tile) on the MXU
+#                            acc += sum_sublane(T * OlT)
+#
+# Exactness: Oh/Ol entries are 0/1 (exact in bf16); within a chunk each
+# (candidate, m*16+lo) output of the matmul sums exactly one nonzero B
+# entry, so T holds exact LUT2 values; the final f32 accumulation matches
+# the one-hot path's rounding class (sum of m LUT values in f32).
+
+_NIBBLE_TILE = 1024
+
+
+def nibble_supported(m: int, ksub: int) -> bool:
+    return ksub == 256 and m % 8 == 0
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def adc_scan_pallas_nibble(lut, codes, tile: int = _NIBBLE_TILE,
+                           interpret: bool = False):
+    """Nibble-decomposed per-query-list ADC scan.
+
+    lut: (nq, m, 256) f32/bf16; codes: (nq, L, m) uint8 -> (nq, L) f32.
+    Same contract as adc_scan_pallas; requires nibble_supported(m, ksub).
+    """
+    nq, m, ksub = lut.shape
+    assert nibble_supported(m, ksub), (m, ksub)
+    L = codes.shape[1]
+    nchunk = m // 8
+    if interpret:
+        tile = min(tile, max(8, L))
+    else:
+        tile = min(tile, max(128, -(-L // 128) * 128))
+    Lp = -(-L // tile) * tile
+    if Lp != L:
+        codes = jnp.pad(codes, ((0, 0), (0, Lp - L), (0, 0)))
+    lut4 = lut.reshape(nq, m, 16, 16)
+
+    def kernel(lut_ref, codes_ref, out_ref, b_ref, oh_ref, ol_ref):
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _build_b():
+            # per-query block-diagonal LUT: B[mc] is (128, 128) with eight
+            # (16, 16) LUT2 blocks on the diagonal — row r = mi*16 + h,
+            # col x = mi*16 + lo. Rebuilt when the query index advances;
+            # reused across all candidate tiles of that query.
+            lane = jax.lax.broadcasted_iota(jnp.int32, (16, 128), 1)
+            for mc in range(nchunk):
+                for mi in range(8):
+                    blk = lut_ref[0, mc * 8 + mi]  # (16, 16)
+                    band = jnp.tile(blk, (1, 8))  # (16, 128)
+                    band = jnp.where((lane // 16) == mi, band,
+                                     jnp.zeros_like(band))
+                    b_ref[mc, mi * 16:(mi + 1) * 16, :] = band
+
+        codes_t = codes_ref[0]  # (tile, m) u8
+        acc = jnp.zeros((1, codes_t.shape[0]), jnp.float32)
+        sub = jax.lax.broadcasted_iota(jnp.int32, (16, codes_t.shape[0]), 0)
+        for mc in range(nchunk):
+            # hi/lo one-hot planes for this chunk, candidates on lanes
+            for mi in range(8):
+                cm = codes_t[:, mc * 8 + mi].astype(jnp.int32)  # (tile,)
+                hi = jax.lax.shift_right_logical(cm, 4)[None, :]
+                lo = jax.lax.bitwise_and(cm, 15)[None, :]
+                oh_ref[mi * 16:(mi + 1) * 16, :] = (sub == hi).astype(oh_ref.dtype)
+                ol_ref[mi * 16:(mi + 1) * 16, :] = (sub == lo).astype(ol_ref.dtype)
+            # T[x, c] = sum_r B[mc][r, x] * OhT[r, c]  — one MXU matmul
+            t = jax.lax.dot_general(
+                b_ref[mc], oh_ref[:, :], (((0,), (0,)), ((), ())),
+                precision=jax.lax.Precision.DEFAULT,
+                preferred_element_type=jnp.float32,
+            )  # (128, tile): exact LUT2 values (one nonzero per output)
+            acc = acc + jnp.sum(t * ol_ref[:, :].astype(jnp.float32), axis=0,
+                                keepdims=True)
+        out_ref[0, :, :] = acc
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nq, Lp // tile),
+        in_specs=[
+            pl.BlockSpec((1, m, 16, 16), lambda i, j: (i, 0, 0, 0)),
+            pl.BlockSpec((1, tile, m), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, tile), lambda i, j: (i, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((nq, 1, Lp), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((nchunk, 128, 128), lut.dtype),
+            pltpu.VMEM((128, tile), lut.dtype),
+            pltpu.VMEM((128, tile), lut.dtype),
+        ],
+        interpret=interpret,
+    )(lut4, codes)
+    return out[:, 0, :L]
+
+
+# runtime knob: flipped off if the nibble kernel fails to compile/run on the
+# actual backend (benchmarks/tpu_validate.py exercises both variants)
+USE_NIBBLE = True
+
+
 def adc_scan_shared_auto(lut, codes, tile: int = DEFAULT_TILE):
     """Pallas on TPU, interpreter elsewhere (tests run the kernel on CPU)."""
     return adc_scan_shared_pallas(lut, codes, tile=tile, interpret=not _on_tpu())
 
 
 def adc_scan_auto(lut, codes, tile: int = DEFAULT_TILE):
+    if USE_NIBBLE and nibble_supported(lut.shape[1], lut.shape[2]):
+        return adc_scan_pallas_nibble(lut, codes, interpret=not _on_tpu())
     return adc_scan_pallas(lut, codes, tile=tile, interpret=not _on_tpu())

@@ -603,6 +603,10 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
                 b, self.mesh, k, nprobe, gsz, self.metric,
             ),
             block=nb,
+            fused_fn=lambda q3: _sharded_ivf_flat_search_fused(
+                self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
+                q3, self.mesh, k, nprobe, gsz, self.metric,
+            ),
         )
 
     def state_dict(self):
@@ -627,6 +631,41 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
             idx._host_assign = [assign]
             idx._n = rows.shape[0]
         return idx
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric"))
+def _sharded_ivf_flat_search_fused(centroids, list_data, list_ids, list_sizes, q3,
+                                   mesh, k: int, nprobe: int, g: int, metric: str):
+    """Multi-block sharded search in one launch: lax.map over stacked query
+    blocks, shard_map per block inside (launch-bound serving — see
+    models.base.pick_query_block)."""
+
+    def body(qb):
+        return _sharded_ivf_flat_search(centroids, list_data, list_ids,
+                                        list_sizes, qb, mesh, k, nprobe, g,
+                                        metric)
+
+    return jax.lax.map(body, q3)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric",
+                                             "use_pallas", "adc_k", "lut_bf16"))
+def _sharded_ivf_pq_search_fused(centroids, codebooks, list_codes, list_ids,
+                                 list_sizes, q3, mesh, k: int, nprobe: int,
+                                 g: int, metric: str, use_pallas: bool = False,
+                                 adc_k: int = 0, raw_data=None,
+                                 lut_bf16: bool = False):
+    """Multi-block masked sharded IVF-PQ in one launch (see
+    _sharded_ivf_flat_search_fused)."""
+
+    def body(qb):
+        return _sharded_ivf_pq_search(centroids, codebooks, list_codes,
+                                      list_ids, list_sizes, qb, mesh, k,
+                                      nprobe, g, metric, use_pallas=use_pallas,
+                                      adc_k=adc_k, raw_data=raw_data,
+                                      lut_bf16=lut_bf16)
+
+    return jax.lax.map(body, q3)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric",
@@ -861,8 +900,20 @@ class ShardedIVFPQIndex(IVFPQIndex):
                 lambda block, n, bucket: guarded(run_routed, block, n, bucket),
                 local_k=adc_k or k,
             )
+        def run_masked_fused(q3, pallas_on):
+            g = probe_group_size(
+                nprobe,
+                ivfmod.pq_probe_payload_bytes(self.lists.cap, self.m, nq_block=nb))
+            return _sharded_ivf_pq_search_fused(
+                self.centroids, self.codebooks, self.lists.data, self.lists.ids,
+                self.lists.sizes, q3, self.mesh, k, nprobe, g, self.metric,
+                use_pallas=pallas_on, adc_k=adc_k, raw_data=raw,
+                lut_bf16=pallas_on and self.adc_lut_bf16,
+            )
+
         return self._search_blocks(q, k, lambda b: guarded(run_masked, b),
-                                   block=nb)
+                                   block=nb,
+                                   fused_fn=lambda q3: guarded(run_masked_fused, q3))
 
     def state_dict(self):
         state = super().state_dict()

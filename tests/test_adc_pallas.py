@@ -89,3 +89,64 @@ def test_tiny_list(rng):
     codes = rng.integers(0, 256, (3, 4)).astype(np.uint8)
     got = np.asarray(adc_pallas.adc_scan_shared_pallas(lut, codes, interpret=True))
     np.testing.assert_allclose(got, np_adc(lut, codes), rtol=1e-5, atol=1e-5)
+
+
+def test_nibble_kernel_golden(rng):
+    nq, m, ksub, L = 5, 8, 256, 300  # L not a tile multiple
+    lut = rng.standard_normal((nq, m, ksub)).astype(np.float32)
+    codes = rng.integers(0, 256, (nq, L, m)).astype(np.uint8)
+    got = np.asarray(adc_pallas.adc_scan_pallas_nibble(lut, codes, tile=128, interpret=True))
+    want = np.zeros((nq, L), np.float32)
+    for qi in range(nq):
+        for mi in range(m):
+            want[qi] += lut[qi, mi, codes[qi, :, mi].astype(np.int64)]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_nibble_matches_onehot_kernel(rng):
+    """Nibble decomposition must reproduce the one-hot kernel (same rounding
+    class: f32 accumulation of exact LUT values)."""
+    nq, m, ksub, L = 4, 64, 256, 520  # flagship m
+    lut = rng.standard_normal((nq, m, ksub)).astype(np.float32)
+    codes = rng.integers(0, 256, (nq, L, m)).astype(np.uint8)
+    a = np.asarray(adc_pallas.adc_scan_pallas_nibble(lut, codes, tile=256, interpret=True))
+    b = np.asarray(adc_pallas.adc_scan_pallas(lut, codes, tile=256, interpret=True))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_nibble_bf16_lut(rng):
+    nq, m, ksub, L = 3, 16, 256, 200
+    import jax.numpy as jnp
+
+    lut = rng.standard_normal((nq, m, ksub)).astype(np.float32)
+    codes = rng.integers(0, 256, (nq, L, m)).astype(np.uint8)
+    got = np.asarray(adc_pallas.adc_scan_pallas_nibble(
+        jnp.asarray(lut).astype(jnp.bfloat16), codes, tile=128, interpret=True))
+    want = np.asarray(pq.adc_scan(lut, codes))
+    # bf16 LUT rounding only (~0.4% rel)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_nibble_auto_dispatch(rng, monkeypatch):
+    """adc_scan_auto picks nibble when geometry allows, one-hot otherwise."""
+    calls = []
+    orig_nib = adc_pallas.adc_scan_pallas_nibble
+    orig_old = adc_pallas.adc_scan_pallas
+
+    def spy_nib(*a, **k):
+        calls.append("nibble")
+        return orig_nib(*a, **k)
+
+    def spy_old(*a, **k):
+        calls.append("onehot")
+        return orig_old(*a, **k)
+
+    monkeypatch.setattr(adc_pallas, "adc_scan_pallas_nibble", spy_nib)
+    monkeypatch.setattr(adc_pallas, "adc_scan_pallas", spy_old)
+    lut8 = rng.standard_normal((2, 8, 256)).astype(np.float32)
+    codes8 = rng.integers(0, 256, (2, 64, 8)).astype(np.uint8)
+    adc_pallas.adc_scan_auto(lut8, codes8)
+    lut4 = rng.standard_normal((2, 4, 256)).astype(np.float32)
+    codes4 = rng.integers(0, 256, (2, 64, 4)).astype(np.uint8)
+    adc_pallas.adc_scan_auto(lut4, codes4)  # m=4 -> one-hot fallback
+    assert calls == ["nibble", "onehot"]
