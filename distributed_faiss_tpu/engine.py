@@ -88,6 +88,8 @@ class _MetaStore:
         raise TypeError("slice access not supported; use tolist()")
 
     def extend(self, items) -> None:
+        if not hasattr(items, "__len__"):
+            items = list(items)  # list.extend parity: accept generators
         m = len(items)
         if m == 0:
             return

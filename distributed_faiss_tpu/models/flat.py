@@ -85,8 +85,6 @@ class FlatIndex(base.TpuIndex):
             empty_d = np.full((nq, k), np.inf if self.metric == "l2" else -np.inf, np.float32)
             return empty_d, np.full((nq, k), -1, np.int64)
         q = np.asarray(q, np.float32)
-        out_s = np.empty((nq, k), np.float32)
-        out_i = np.empty((nq, k), np.int64)
         kwargs = {}
         if self.codec == "sq8":
             kwargs = {"codec": "sq8", "vmin": self.sq_params["vmin"], "span": self.sq_params["span"]}
@@ -96,8 +94,10 @@ class FlatIndex(base.TpuIndex):
         nb = base.pick_query_block(65536 * 4)
         if nq > nb:
             # multi-block batch: one launch for all blocks (trailing block
-            # padded to full width — extra compute only)
-            nblocks = -(-nq // nb)
+            # padded to full width — extra compute only). nblocks bucketed to
+            # powers of two so variable-batch serving compiles O(log max)
+            # fused variants, not one per distinct batch size
+            nblocks = base._next_pow2(-(-nq // nb), 1)
             qp = np.pad(q, ((0, nblocks * nb - nq), (0, 0)))
             vals, ids = _flat_search_fused(
                 jnp.asarray(qp.reshape(nblocks, nb, -1)), self.store.data,
@@ -107,6 +107,8 @@ class FlatIndex(base.TpuIndex):
             out_s = np.asarray(vals).reshape(nblocks * nb, -1)[:nq]
             out_i = np.asarray(ids).reshape(nblocks * nb, -1)[:nq].astype(np.int64)
             return base.finalize_results(out_s, out_i, self.metric)
+        out_s = np.empty((nq, k), np.float32)
+        out_i = np.empty((nq, k), np.int64)
         for s, n, block in base.query_blocks(q, nb):
             vals, ids = distance.knn(
                 block, self.store.data, k, metric=self.metric, ntotal=self.store.ntotal, **kwargs

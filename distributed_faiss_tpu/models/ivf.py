@@ -346,13 +346,16 @@ class _IVFBase(base.TpuIndex):
         runs in ONE launch — on the launch-bound relay that saves
         (nblocks-1) * ~66 ms per search call. The trailing block is padded
         to full width inside the fused path (extra compute only, free in
-        the launch-bound regime); jit variants are keyed on nblocks, so
-        offline/bench callers with a stable batch size compile once.
+        the launch-bound regime); jit variants are keyed on nblocks, which
+        is bucketed to powers of two so a variable-batch serving workload
+        compiles O(log max_batch) fused variants (each sharded variant is a
+        multi-second compile) instead of one per distinct batch size —
+        offline/bench callers with a stable batch size still compile once.
         """
         q = np.asarray(q, np.float32)
         nq = q.shape[0]
         if fused_fn is not None and nq > block:
-            nblocks = -(-nq // block)
+            nblocks = base._next_pow2(-(-nq // block), 1)
             qp = np.pad(q, ((0, nblocks * block - nq), (0, 0)))
             vals, ids = fused_fn(jnp.asarray(qp.reshape(nblocks, block, -1)))
             out_s = np.asarray(vals).reshape(nblocks * block, -1)[:nq]
@@ -517,6 +520,84 @@ class IVFFlatIndex(_IVFBase):
         return idx
 
 
+from distributed_faiss_tpu.ops import adc_pallas as _adc_pallas  # noqa: E402
+
+_adc_pallas.NIBBLE_JIT_CONSUMERS += [_ivf_pq_search, _ivf_pq_search_fused]
+
+
+def disable_nibble(m: int, ksub: int) -> bool:
+    """Turn off the nibble ADC kernel process-wide if it could have been in
+    the failing trace. Returns True when the caller should retry pallas.
+
+    Flipping adc_pallas.USE_NIBBLE alone is not enough: the dispatch is read
+    at trace time, so every compiled variant that baked the nibble kernel in
+    (adc_pallas.NIBBLE_JIT_CONSUMERS — the unsharded AND sharded programs)
+    must be dropped or a later call hits the stale executable, re-faults,
+    and wrongly demotes the one-hot kernel too.
+    """
+    if not (_adc_pallas.USE_NIBBLE and _adc_pallas.nibble_supported(m, ksub)):
+        return False
+    _adc_pallas.USE_NIBBLE = False
+    for fn in _adc_pallas.NIBBLE_JIT_CONSUMERS:
+        fn.clear_cache()
+    return True
+
+
+def pallas_guarded(index, call, m: int, ksub: int):
+    """Run ``call(use_pallas)``, degrading one kernel at a time on failure:
+    nibble pallas -> one-hot pallas -> XLA one-hot (ADVICE r3: a nibble
+    failure must not abandon the proven one-hot kernel).
+
+    A downgrade sticks only if a later rung succeeds — when every rung fails
+    (a user error, not a kernel fault) the nibble intent is restored before
+    re-raising, so the next valid search still runs the configured kernel.
+    ``index`` provides use_pallas/_pallas_runtime_ok; every rung executes
+    under ``jax.block_until_ready`` so asynchronous kernel aborts surface
+    here, not at a later np.asarray.
+    """
+    with_pallas = index.use_pallas and index._pallas_runtime_ok
+    try:
+        out = call(with_pallas)
+        jax.block_until_ready(out)
+        return out
+    except Exception:
+        if not with_pallas:
+            raise
+        nibble_demoted = disable_nibble(m, ksub)
+        if nibble_demoted:
+            try:
+                out = call(True)
+                jax.block_until_ready(out)
+                logger.exception(
+                    "nibble ADC kernel failed on this backend; the one-hot "
+                    "pallas kernel works and stays active (USE_NIBBLE off "
+                    "for the rest of this process)"
+                )
+                return out
+            except Exception:
+                pass  # one-hot pallas is also broken here; fall to XLA
+        try:
+            out = call(False)
+            jax.block_until_ready(out)
+        except Exception:
+            if nibble_demoted:
+                # the XLA path failed identically, so the fault was never
+                # the nibble kernel — restore the intent, and drop the
+                # one-hot variants rungs 2/3 just cached under it or they
+                # would shadow the restored dispatch for these signatures
+                _adc_pallas.USE_NIBBLE = True
+                for fn in _adc_pallas.NIBBLE_JIT_CONSUMERS:
+                    fn.clear_cache()
+            raise
+        logger.exception(
+            "pallas ADC kernel failed on this backend; using the XLA path "
+            "for the rest of this process (persisted use_pallas intent is "
+            "unchanged)"
+        )
+        index._pallas_runtime_ok = False
+        return out
+
+
 class IVFPQIndex(_IVFBase):
     """IVF-PQ: inverted lists of m uint8 codes per vector, ADC search.
 
@@ -600,27 +681,9 @@ class IVFPQIndex(_IVFBase):
             )
 
         def run(b):
-            with_pallas = self.use_pallas and self._pallas_runtime_ok
-            try:
-                vals, ids = adc(b, with_pallas)
-                # surface asynchronous execution faults inside this try —
-                # otherwise a runtime kernel abort raises later at the
-                # np.asarray in _search_blocks, past the fallback
-                jax.block_until_ready((vals, ids))
-            except Exception:
-                if not with_pallas:
-                    raise
-                # only conclude the kernel is at fault if the XLA path
-                # succeeds where pallas failed; a user error (bad dim etc.)
-                # re-raises from the retry with use_pallas intent intact
-                vals, ids = adc(b, False)
-                jax.block_until_ready((vals, ids))
-                logger.exception(
-                    "pallas ADC kernel failed on this backend; using the XLA "
-                    "path for the rest of this process (persisted use_pallas "
-                    "intent is unchanged)"
-                )
-                self._pallas_runtime_ok = False
+            vals, ids = pallas_guarded(
+                self, lambda p: adc(b, p), self.m, self.codebooks.shape[1],
+            )
             if self.refine_k_factor:
                 vals, ids = _rerank_exact(self.refine_store.data, b, ids, k, self.metric)
             return vals, ids
@@ -637,23 +700,10 @@ class IVFPQIndex(_IVFBase):
             )
 
         def run_fused(q3):
-            # same pallas runtime-fallback protocol as the per-block path
-            with_pallas = self.use_pallas and self._pallas_runtime_ok
-            try:
-                out = adc_fused(q3, with_pallas)
-                jax.block_until_ready(out)
-            except Exception:
-                if not with_pallas:
-                    raise
-                out = adc_fused(q3, False)
-                jax.block_until_ready(out)
-                logger.exception(
-                    "pallas ADC kernel failed on this backend; using the XLA "
-                    "path for the rest of this process (persisted use_pallas "
-                    "intent is unchanged)"
-                )
-                self._pallas_runtime_ok = False
-            return out
+            # same degrade ladder as the per-block path
+            return pallas_guarded(
+                self, lambda p: adc_fused(q3, p), self.m, self.codebooks.shape[1],
+            )
 
         return self._search_blocks(q, k, run, block=nb, fused_fn=run_fused)
 

@@ -283,9 +283,19 @@ def adc_scan_pallas_nibble(lut, codes, tile: int = _NIBBLE_TILE,
     return out[:, 0, :L]
 
 
-# runtime knob: flipped off if the nibble kernel fails to compile/run on the
-# actual backend (benchmarks/tpu_validate.py exercises both variants)
+# runtime knob: flipped off (by models.ivf.disable_nibble, which also drops
+# the compiled variants that baked the dispatch in at trace time) if the
+# nibble kernel fails to compile/run on the actual backend
+# (benchmarks/tpu_validate.py exercises both variants)
 USE_NIBBLE = True
+
+# every jitted program that calls adc_scan_auto inside its trace registers
+# here (models/ivf.py, parallel/mesh.py at import). disable_nibble must
+# clear ALL of them: a nibble abort surfaces through whichever entry point
+# ran first, but the same broken kernel is baked into every cached variant
+# of every consumer — clearing only the one that faulted would let the next
+# entry point re-fault and wrongly demote the one-hot pallas kernel too.
+NIBBLE_JIT_CONSUMERS = []
 
 
 def adc_scan_shared_auto(lut, codes, tile: int = DEFAULT_TILE):
@@ -293,7 +303,15 @@ def adc_scan_shared_auto(lut, codes, tile: int = DEFAULT_TILE):
     return adc_scan_shared_pallas(lut, codes, tile=tile, interpret=not _on_tpu())
 
 
-def adc_scan_auto(lut, codes, tile: int = DEFAULT_TILE):
+def adc_scan_auto(lut, codes, tile=None):
+    """Dispatch to the nibble kernel when eligible, else the one-hot kernel.
+
+    tile=None (the default for every in-tree caller) lets each kernel use
+    its own tuned tile (_NIBBLE_TILE vs DEFAULT_TILE — they have different
+    VMEM footprints); an explicit tile is forwarded to whichever kernel
+    dispatches.
+    """
+    tile_kw = {} if tile is None else {"tile": tile}
     if USE_NIBBLE and nibble_supported(lut.shape[1], lut.shape[2]):
-        return adc_scan_pallas_nibble(lut, codes, interpret=not _on_tpu())
-    return adc_scan_pallas(lut, codes, tile=tile, interpret=not _on_tpu())
+        return adc_scan_pallas_nibble(lut, codes, interpret=not _on_tpu(), **tile_kw)
+    return adc_scan_pallas(lut, codes, interpret=not _on_tpu(), **tile_kw)

@@ -844,7 +844,6 @@ class ShardedIVFPQIndex(IVFPQIndex):
             )
         adc_k = k * self.refine_k_factor if refining else 0
         raw = self.raw_lists.data if refining else None
-        with_pallas = self.use_pallas and self._pallas_runtime_ok
 
         # pair group sized so codes + one-hot transients stay bounded; the
         # bucket rounding in _routed_search_blocks closes over the same value
@@ -874,25 +873,11 @@ class ShardedIVFPQIndex(IVFPQIndex):
             )
 
         def guarded(call, *args):
-            # same kernel-fault fallback discipline as the unsharded path:
-            # only blame pallas if the XLA path succeeds where it failed
-            nonlocal with_pallas
-            try:
-                out = call(*args, with_pallas)
-                jax.block_until_ready(out)
-                return out
-            except Exception:
-                if not with_pallas:
-                    raise
-                out = call(*args, False)
-                jax.block_until_ready(out)
-                logger.exception(
-                    "pallas ADC kernel failed on this backend; using the XLA "
-                    "path for the rest of this process"
-                )
-                self._pallas_runtime_ok = False
-                with_pallas = False
-                return out
+            # same degrade ladder as the unsharded path: nibble pallas ->
+            # one-hot pallas -> XLA, one rung per proven failure
+            return ivfmod.pallas_guarded(
+                self, lambda p: call(*args, p), self.m, self.codebooks.shape[1],
+            )
 
         if self.probe_routing:
             return _routed_search_blocks(
@@ -1306,3 +1291,14 @@ def routed_pair_bucket(nq: int, nprobe: int, S: int, group: int, slack: float = 
     """Fixed per-chip pair budget: slack x the uniform share, group-aligned."""
     b = max(group, int(-(-nq * nprobe * slack // S)))
     return -(-b // group) * group
+
+
+# these sharded programs bake the adc_scan_auto nibble dispatch in at trace
+# time; disable_nibble (models/ivf.py) must be able to drop their cached
+# variants along with the unsharded ones
+from distributed_faiss_tpu.ops import adc_pallas as _adc_pallas  # noqa: E402
+
+_adc_pallas.NIBBLE_JIT_CONSUMERS += [
+    _sharded_ivf_pq_search, _sharded_ivf_pq_search_fused,
+    _sharded_ivf_pq_search_routed,
+]

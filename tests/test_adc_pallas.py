@@ -150,3 +150,66 @@ def test_nibble_auto_dispatch(rng, monkeypatch):
     codes4 = rng.integers(0, 256, (2, 64, 4)).astype(np.uint8)
     adc_pallas.adc_scan_auto(lut4, codes4)  # m=4 -> one-hot fallback
     assert calls == ["nibble", "onehot"]
+
+
+def test_auto_forwards_explicit_tile(rng, monkeypatch):
+    """An explicit tile reaches whichever kernel dispatches; tile=None lets
+    each kernel use its own tuned default (ADVICE r3)."""
+    seen = {}
+    orig_nib = adc_pallas.adc_scan_pallas_nibble
+
+    def spy_nib(lut, codes, **k):
+        seen.update(k)
+        return orig_nib(lut, codes, **k)
+
+    monkeypatch.setattr(adc_pallas, "adc_scan_pallas_nibble", spy_nib)
+    lut = rng.standard_normal((1, 8, 256)).astype(np.float32)
+    codes = rng.integers(0, 256, (1, 64, 8)).astype(np.uint8)
+    adc_pallas.adc_scan_auto(lut, codes)
+    assert "tile" not in seen
+    adc_pallas.adc_scan_auto(lut, codes, tile=256)
+    assert seen["tile"] == 256
+
+
+def test_pallas_degrade_ladder(rng, monkeypatch):
+    """A nibble-kernel failure falls back to the one-hot pallas kernel, not
+    straight to XLA; a one-hot failure then falls to XLA (ADVICE r3)."""
+    from distributed_faiss_tpu.models import ivf as ivfmod
+    from distributed_faiss_tpu.models.ivf import IVFPQIndex
+
+    n, d, m = 1500, 32, 8
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((6, d)).astype(np.float32)
+    idx = IVFPQIndex(d, 8, m=m, metric="dot", kmeans_iters=3, pq_iters=3,
+                     use_pallas=True)
+    idx.train(x)
+    idx.add(x)
+    idx.set_nprobe(4)
+    ref = IVFPQIndex(d, 8, m=m, metric="dot", kmeans_iters=3, pq_iters=3,
+                     use_pallas=False)
+    ref.centroids, ref.codebooks = idx.centroids, idx.codebooks
+    ref.lists = idx.lists
+    ref._n = idx._n
+    ref.set_nprobe(4)
+    want_d, want_i = ref.search(q, 5)
+
+    def boom(*a, **k):
+        raise RuntimeError("kernel abort (injected)")
+
+    # drop compiled variants so the injected failure is actually reached
+    ivfmod._ivf_pq_search.clear_cache()
+    monkeypatch.setattr(adc_pallas, "USE_NIBBLE", True)
+    monkeypatch.setattr(adc_pallas, "adc_scan_pallas_nibble", boom)
+    got_d, got_i = idx.search(q, 5)
+    assert adc_pallas.USE_NIBBLE is False, "nibble not demoted"
+    assert idx._pallas_runtime_ok, "one-hot pallas abandoned with the nibble"
+    np.testing.assert_array_equal(got_i, want_i)
+    np.testing.assert_allclose(got_d, want_d, rtol=1e-4, atol=1e-4)
+
+    # now the one-hot kernel breaks too -> XLA path, pallas disabled
+    ivfmod._ivf_pq_search.clear_cache()
+    monkeypatch.setattr(adc_pallas, "adc_scan_pallas", boom)
+    got_d, got_i = idx.search(q, 5)
+    assert not idx._pallas_runtime_ok
+    np.testing.assert_array_equal(got_i, want_i)
+    np.testing.assert_allclose(got_d, want_d, rtol=1e-4, atol=1e-4)
