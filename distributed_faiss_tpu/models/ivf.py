@@ -526,73 +526,111 @@ _adc_pallas.NIBBLE_JIT_CONSUMERS += [_ivf_pq_search, _ivf_pq_search_fused]
 
 
 def disable_nibble(m: int, ksub: int) -> bool:
-    """Turn off the nibble ADC kernel process-wide if it could have been in
-    the failing trace. Returns True when the caller should retry pallas.
+    """Turn off the nibble ADC kernel process-wide (one-way, idempotent).
 
     Flipping adc_pallas.USE_NIBBLE alone is not enough: the dispatch is read
     at trace time, so every compiled variant that baked the nibble kernel in
     (adc_pallas.NIBBLE_JIT_CONSUMERS — the unsharded AND sharded programs)
-    must be dropped or a later call hits the stale executable, re-faults,
-    and wrongly demotes the one-hot kernel too.
+    must be dropped or a later call hits the stale executable and re-faults.
+    The lock makes concurrent demotions clear the caches exactly once; the
+    flag is never restored (monotone), which is what makes the at-call-time
+    attribution in pallas_guarded sound under concurrency.
     """
-    if not (_adc_pallas.USE_NIBBLE and _adc_pallas.nibble_supported(m, ksub)):
+    if not _adc_pallas.nibble_supported(m, ksub):
         return False
-    _adc_pallas.USE_NIBBLE = False
-    for fn in _adc_pallas.NIBBLE_JIT_CONSUMERS:
-        fn.clear_cache()
+    with _adc_pallas.NIBBLE_LOCK:
+        if not _adc_pallas.USE_NIBBLE:
+            return False  # already demoted; caches already cleared
+        _adc_pallas.USE_NIBBLE = False
+        for fn in _adc_pallas.NIBBLE_JIT_CONSUMERS:
+            fn.clear_cache()
     return True
 
 
 def pallas_guarded(index, call, m: int, ksub: int):
-    """Run ``call(use_pallas)``, degrading one kernel at a time on failure:
-    nibble pallas -> one-hot pallas -> XLA one-hot (ADVICE r3: a nibble
-    failure must not abandon the proven one-hot kernel).
+    """Run ``call(use_pallas)`` with kernel-fault attribution (ADVICE r3: a
+    nibble failure must not abandon the proven one-hot kernel).
 
-    A downgrade sticks only if a later rung succeeds — when every rung fails
-    (a user error, not a kernel fault) the nibble intent is restored before
-    re-raising, so the next valid search still runs the configured kernel.
-    ``index`` provides use_pallas/_pallas_runtime_ok; every rung executes
+    On failure the XLA path runs first as a side-effect-free ORACLE: if it
+    fails too, the request itself is bad — re-raise with no flag flips and
+    no cache wipes (a misbehaving client must not evict healthy compiled
+    variants). If XLA succeeds, a kernel is at fault; which one is decided
+    by the nibble state captured BEFORE the call: USE_NIBBLE is monotone
+    (never restored), so nibble_was_on means the failing executable may
+    have baked the nibble kernel in — demote nibble only and let the next
+    search try the one-hot pallas kernel; nibble_was_off blames the
+    one-hot kernel, but only after it fails a FRESH trace (an in-flight
+    trace started before a concurrent demotion can re-insert a stale
+    nibble executable after the sweep). A broken one-hot behind a broken
+    nibble therefore converges within two failing searches, each serving
+    its caller from the XLA result in hand.
+    ``index`` provides use_pallas/_pallas_runtime_ok; every attempt runs
     under ``jax.block_until_ready`` so asynchronous kernel aborts surface
     here, not at a later np.asarray.
     """
     with_pallas = index.use_pallas and index._pallas_runtime_ok
+    nibble_was_on = _adc_pallas.USE_NIBBLE
     try:
         out = call(with_pallas)
         jax.block_until_ready(out)
         return out
-    except Exception:
+    except Exception as kernel_err:
         if not with_pallas:
             raise
-        nibble_demoted = disable_nibble(m, ksub)
-        if nibble_demoted:
-            try:
-                out = call(True)
-                jax.block_until_ready(out)
-                logger.exception(
-                    "nibble ADC kernel failed on this backend; the one-hot "
-                    "pallas kernel works and stays active (USE_NIBBLE off "
-                    "for the rest of this process)"
-                )
-                return out
-            except Exception:
-                pass  # one-hot pallas is also broken here; fall to XLA
+        nibble_eligible = _adc_pallas.nibble_supported(m, ksub)
+        # XLA oracle: side-effect-free arbiter of "bad request" vs "bad
+        # kernel"
         try:
             out = call(False)
             jax.block_until_ready(out)
-        except Exception:
-            if nibble_demoted:
-                # the XLA path failed identically, so the fault was never
-                # the nibble kernel — restore the intent, and drop the
-                # one-hot variants rungs 2/3 just cached under it or they
-                # would shadow the restored dispatch for these signatures
-                _adc_pallas.USE_NIBBLE = True
-                for fn in _adc_pallas.NIBBLE_JIT_CONSUMERS:
-                    fn.clear_cache()
+        except Exception as oracle_err:
+            # the same failure on both paths = the request itself is bad
+            # (a dim mismatch raises in the shared coarse-scoring prefix):
+            # re-raise with no flag flips and no cache wipes, so a
+            # misbehaving client cannot evict healthy compiled variants. A
+            # DIFFERENT oracle failure (say the XLA path OOMs materializing
+            # the one-hot the pallas kernel exists to avoid) does NOT
+            # exonerate the nibble kernel — demote it so the next search
+            # tries the one-hot pallas rung instead of re-faulting forever.
+            if (nibble_eligible and nibble_was_on
+                    and str(oracle_err) != str(kernel_err)):
+                disable_nibble(m, ksub)
+                logger.exception(
+                    "pallas ADC failure plus a distinct XLA-oracle failure: "
+                    "nibble demoted; the one-hot pallas kernel runs from "
+                    "the next search on"
+                )
             raise
+        if nibble_eligible and nibble_was_on:
+            disable_nibble(m, ksub)
+            logger.exception(
+                "pallas ADC failure with the nibble kernel eligible: nibble "
+                "demoted for this process; the one-hot pallas kernel runs "
+                "from the next search on (this request served via XLA)"
+            )
+            return out
+        if nibble_eligible:
+            # nibble was already off at call time — but an executable traced
+            # BEFORE a concurrent demotion can land in the cache after its
+            # sweep, still baking the nibble kernel in. Blame the one-hot
+            # kernel only after it fails a FRESH trace.
+            for fn in _adc_pallas.NIBBLE_JIT_CONSUMERS:
+                fn.clear_cache()
+            try:
+                out2 = call(True)
+                jax.block_until_ready(out2)
+                logger.exception(
+                    "pallas ADC failure came from a stale pre-demotion "
+                    "executable; a fresh one-hot trace works (pallas stays "
+                    "active)"
+                )
+                return out2
+            except Exception:
+                pass
         logger.exception(
-            "pallas ADC kernel failed on this backend; using the XLA path "
-            "for the rest of this process (persisted use_pallas intent is "
-            "unchanged)"
+            "pallas ADC (one-hot) kernel failed on this backend; using "
+            "the XLA path for the rest of this process (persisted "
+            "use_pallas intent is unchanged)"
         )
         index._pallas_runtime_ok = False
         return out

@@ -19,6 +19,7 @@ Pallas interpreter so CPU tests cover the exact kernel code path.
 """
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -296,6 +297,10 @@ USE_NIBBLE = True
 # of every consumer — clearing only the one that faulted would let the next
 # entry point re-fault and wrongly demote the one-hot pallas kernel too.
 NIBBLE_JIT_CONSUMERS = []
+
+# serializes USE_NIBBLE demotion + the clear_cache sweep (disable_nibble in
+# models/ivf.py) so concurrent searches demote exactly once
+NIBBLE_LOCK = threading.Lock()
 
 
 def adc_scan_shared_auto(lut, codes, tile: int = DEFAULT_TILE):

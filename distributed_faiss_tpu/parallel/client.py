@@ -49,6 +49,16 @@ def merge_result_blocks(
     return np.take_along_axis(all_d, ids, axis=1), ids.astype(np.int64)
 
 
+class _FailedRank:
+    """Sentinel carrying the stub + error of a rank that failed a fan-out
+    call (cannot collide with a server's (scores, meta, embs) tuple)."""
+
+    __slots__ = ("stub", "error")
+
+    def __init__(self, stub, error):
+        self.stub, self.error = stub, error
+
+
 class IndexClient:
     """Handle to a cluster of index servers (one shard each)."""
 
@@ -197,7 +207,27 @@ class IndexClient:
         topk: int,
         index_id: str,
         return_embeddings: bool = False,
-    ) -> Tuple[np.ndarray, List]:
+        allow_partial: bool = False,
+        partial_timeout: Optional[float] = None,
+    ) -> tuple:  # (D, meta[, embs][, missing]) — see docstring
+        """Fan-out search with client-side top-k merge.
+
+        allow_partial=False (default, reference behavior): any dead rank
+        raises. allow_partial=True completes the hook the reference stubbed
+        and never implemented (client.py:69-76 keeps a rank map "for
+        rebalancing" that nothing uses): TRANSPORT-dead ranks (unreachable,
+        connection lost, deadline expired) are skipped, top-k is served
+        from the surviving shards, and the return gains a trailing
+        ``missing`` list — one {server, host, port, error} dict per dead
+        rank (empty == complete results). Application errors from a live
+        rank (ServerException: index not loaded/trained, bad args) still
+        raise — masking those would silently drop a healthy shard's corpus.
+        Raises if EVERY rank is transport-dead.
+        partial_timeout additionally bounds each per-server RPC with a
+        socket deadline so a hung (not just dead) rank degrades too; on
+        expiry that stub's connection is closed and a later retry needs a
+        fresh IndexClient (same contract as ping).
+        """
         q_size = query.shape[0]
         if self.cfg is None:
             # without the metric we cannot merge correctly (dot needs
@@ -207,13 +237,50 @@ class IndexClient:
                 "construction, or call create_index/load_index first"
             )
         maximize_metric = self.cfg.metric == "dot"
-        results = self.pool.imap(
-            lambda idx: idx.search(index_id, query, topk, return_embeddings),
-            self.sub_indexes,
+        if not allow_partial:
+            results = self.pool.imap(
+                lambda idx: idx.search(index_id, query, topk, return_embeddings),
+                self.sub_indexes,
+            )
+            return IndexClient._aggregate_results(
+                results, topk, q_size, maximize_metric, return_embeddings
+            )
+
+        def one(idx):
+            try:
+                return idx.generic_fun(
+                    "search", (index_id, query, topk, return_embeddings),
+                    timeout=partial_timeout,
+                )
+            # TRANSPORT failures only (dead/unreachable/hung rank — OSError
+            # covers refused/reset/broken-pipe/socket-timeout; EOFError a
+            # mid-frame stream end). A ServerException means the rank is
+            # alive and rejected the request (index not loaded, not
+            # trained, bad args): masking it as "missing" would silently
+            # drop a healthy shard's corpus from every result, so it
+            # propagates in partial mode too.
+            except (OSError, EOFError) as e:
+                logger.warning(
+                    "rank %s (%s:%s) unreachable during search; serving "
+                    "partial results: %s", idx.id, idx.host, idx.port, e,
+                )
+                return _FailedRank(idx, e)
+
+        raw = self.pool.map(one, self.sub_indexes)
+        ok = [r for r in raw if not isinstance(r, _FailedRank)]
+        missing = [
+            {"server": r.stub.id, "host": r.stub.host, "port": r.stub.port,
+             "error": f"{type(r.error).__name__}: {r.error}"}
+            for r in raw if isinstance(r, _FailedRank)
+        ]
+        if not ok:
+            raise RuntimeError(
+                f"search failed on every rank: {[m['error'] for m in missing]}"
+            )
+        merged = IndexClient._aggregate_results(
+            iter(ok), topk, q_size, maximize_metric, return_embeddings
         )
-        return IndexClient._aggregate_results(
-            results, topk, q_size, maximize_metric, return_embeddings
-        )
+        return merged + (missing,)
 
     @staticmethod
     def _aggregate_results(

@@ -200,6 +200,14 @@ def test_pallas_degrade_ladder(rng, monkeypatch):
     ivfmod._ivf_pq_search.clear_cache()
     monkeypatch.setattr(adc_pallas, "USE_NIBBLE", True)
     monkeypatch.setattr(adc_pallas, "adc_scan_pallas_nibble", boom)
+
+    # a user error (bad dim) re-raises from the XLA oracle with every
+    # kernel flag untouched — no demotion, no cache wipe
+    with pytest.raises(Exception):
+        idx.search(rng.standard_normal((2, d + 1)).astype(np.float32), 5)
+    assert adc_pallas.USE_NIBBLE is True
+    assert idx._pallas_runtime_ok
+
     got_d, got_i = idx.search(q, 5)
     assert adc_pallas.USE_NIBBLE is False, "nibble not demoted"
     assert idx._pallas_runtime_ok, "one-hot pallas abandoned with the nibble"
