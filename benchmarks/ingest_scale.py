@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Bulk-ingest throughput at reference scale (VERDICT r3 item 6).
+
+The reference's headline workflow is a memmap load across a server fleet
+(README.md:147-176, scripts/load_data.py with periodic saves). This runs
+that exact pipeline — scripts/load_data.py against a launch_local
+subprocess cluster — at 1e7 x 128-d rows / 4 ranks by default and reports
+end-to-end ingest rows/s (memmap read + fp32 convert + binary RPC +
+server buffering + async index add), excluding the final save.
+
+CPU measures the protocol path (the driver's relay makes per-launch
+dispatch the TPU bottleneck anyway — RESULTS.md "launch-bound serving");
+run on the real chip via benchmarks/hw_sweep.sh when the relay lives.
+
+    python benchmarks/ingest_scale.py [--rows 10000000] [--dim 128]
+        [--ranks 4] [--bs 20000] [--keep]
+
+Prints one JSON line: {"metric": "bulk ingest rows/s ...", ...}.
+"""
+
+import argparse
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_000_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--bs", type=int, default=20_000)
+    ap.add_argument("--base-port", type=int, default=13741)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the temp dir (memmap + index storage)")
+    args = ap.parse_args()
+
+    sys.path.insert(0, REPO)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    tmp = tempfile.mkdtemp(prefix="ingest_scale_")
+    mmap_path = os.path.join(tmp, "data.mmap")
+    disc = os.path.join(tmp, "disc.txt")
+    storage = os.path.join(tmp, "storage")
+
+    from distributed_faiss_tpu.parallel import launcher
+    from distributed_faiss_tpu.utils.config import IndexCfg
+
+    t_mk = time.time()
+    subprocess.run(
+        [sys.executable, "scripts/load_data.py", "--data", mmap_path,
+         "--dtype", "fp16", "--dim", str(args.dim), "--discovery", disc,
+         "--make-random", str(args.rows)],
+        cwd=REPO, env=env, check=True, capture_output=True,
+    )
+    print(f"memmap ready ({args.rows}x{args.dim} fp16, "
+          f"{os.path.getsize(mmap_path) / 2 ** 30:.2f} GiB, "
+          f"{time.time() - t_mk:.0f}s)", file=sys.stderr)
+
+    cfg = IndexCfg(index_builder_type="flat", dim=args.dim, metric="l2",
+                   train_num=100_000)
+    cfg_path = os.path.join(tmp, "cfg.json")
+    cfg.save(cfg_path)
+
+    procs = launcher.launch_local(args.ranks, disc, storage,
+                                  base_port=args.base_port, env=env)
+    rc = 1
+    try:
+        t0 = time.time()
+        out = subprocess.run(
+            [sys.executable, "scripts/load_data.py", "--data", mmap_path,
+             "--dtype", "fp16", "--dim", str(args.dim), "--bs", str(args.bs),
+             "--discovery", disc, "--index-id", "ingest", "--cfg", cfg_path],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=4 * 3600,
+        )
+        wall = time.time() - t0
+        log = out.stdout + out.stderr
+        if out.returncode != 0:
+            print(log[-4000:], file=sys.stderr)
+            raise SystemExit(f"loader failed rc={out.returncode}")
+        # "load complete: N rows in Xs; ntotal=N" — ingest only, save excluded
+        m = re.search(r"load complete: (\d+) rows in ([\d.]+)s; ntotal=(\d+)",
+                      log)
+        assert m, log[-2000:]
+        rows, secs, ntotal = int(m.group(1)), float(m.group(2)), int(m.group(3))
+        assert ntotal == rows, (ntotal, rows)
+        rate = rows / secs
+        print(json.dumps({
+            "metric": (f"bulk ingest rows/s (backend=cpu protocol path, "
+                       f"{args.ranks} subprocess ranks, flat-f32, "
+                       f"{rows}x{args.dim} fp16 memmap, bs={args.bs}; "
+                       f"wall incl. save {wall:.0f}s)"),
+            "value": round(rate, 1),
+            "unit": "rows/s",
+            "rows": rows,
+            "ingest_seconds": round(secs, 1),
+        }))
+        rc = 0
+    finally:
+        for p in procs:
+            p.kill()
+        if not args.keep:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -225,8 +225,10 @@ class IndexClient:
         Raises if EVERY rank is transport-dead.
         partial_timeout additionally bounds each per-server RPC with a
         socket deadline so a hung (not just dead) rank degrades too; on
-        expiry that stub's connection is closed and a later retry needs a
-        fresh IndexClient (same contract as ping).
+        expiry that stub's connection is dropped and the NEXT call on the
+        same stub redials automatically (rpc.Client auto-reconnect with a
+        short budget + cooldown) — a restarted rank rejoins this client's
+        fan-out without rebuilding the IndexClient.
         """
         q_size = query.shape[0]
         if self.cfg is None:
@@ -426,8 +428,8 @@ class IndexClient:
         """Health-check every server; returns per-server dicts or the error
         for dead/hung ones. A per-call socket deadline enforces the
         no-hang guarantee even for a SIGSTOP'd-but-connected server (the
-        stub's connection is closed on expiry — a later retry reconnects
-        via a fresh IndexClient)."""
+        stub's connection is dropped on expiry and redialed automatically
+        on its next call — rpc.Client auto-reconnect)."""
 
         def one(idx):
             try:

@@ -158,43 +158,83 @@ class Client:
     """Dynamic-dispatch RPC stub: any attribute is a remote method
     (reference rpc.py:137-138). One persistent connection, thread-safe."""
 
+    # redial budget for a stub whose previous call hit a transport failure:
+    # short, so a still-dead rank fails fast inside degraded-mode fan-outs,
+    # but enough for a restarted rank's accept loop
+    RECONNECT_TIMEOUT = 2.0
+    # after a failed redial, calls fail instantly for this long instead of
+    # each burning the full RECONNECT_TIMEOUT — a degraded-mode fan-out
+    # during an outage pays the redial budget once per cooldown window,
+    # not once per search
+    REDIAL_COOLDOWN = 2.0
+
     def __init__(self, client_id: int, host: str, port: int, v6: bool = False,
                  connect_timeout: float = 60.0):
         self.id = client_id
         self.host = host
         self.port = port
-        fam = socket.AF_INET6 if v6 else socket.AF_INET
+        self._fam = socket.AF_INET6 if v6 else socket.AF_INET
+        self._connect(connect_timeout)
+        self._lock = threading.Lock()
+        self._closed = False
+        self._shutdown = False
+        self._next_redial = 0.0
+
+    def _connect(self, connect_timeout: float) -> None:
         # a server may register in the discovery file moments before its
         # accept loop is up (the reference has the same gap,
-        # server_launcher.py:64 vs server.py:95): retry with backoff
+        # server_launcher.py:64 vs server.py:95): retry with backoff.
+        # Each attempt carries a socket deadline bounded by the remaining
+        # budget — without it, a blackholed host blocks connect() for the
+        # kernel SYN timeout (minutes), far past connect_timeout
         deadline = time.time() + connect_timeout
         delay = 0.05
         while True:
-            self.sock = socket.socket(fam, socket.SOCK_STREAM)
+            self.sock = socket.socket(self._fam, socket.SOCK_STREAM)
             self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             try:
-                self.sock.connect((host, port))
-                break
-            except (ConnectionRefusedError, ConnectionAbortedError, OSError):
+                self.sock.settimeout(
+                    max(0.05, min(connect_timeout, deadline - time.time())))
+                self.sock.connect((self.host, self.port))
+                self.sock.settimeout(None)
+                return
+            except OSError:
                 self.sock.close()
                 if time.time() + delay > deadline:
                     raise
                 time.sleep(delay)
                 delay = min(delay * 1.6, 2.0)
-        self._lock = threading.Lock()
-        self._closed = False
 
     def generic_fun(self, fname: str, args=(), kwargs=None, timeout: float = None):
         """Remote call. With ``timeout``, the socket gets a deadline for this
         call; on expiry the connection is closed (a partial frame would
-        desync the stream) and socket.timeout propagates."""
+        desync the stream) and socket.timeout propagates. Any transport
+        failure likewise drops the connection, and the NEXT call redials
+        (RECONNECT_TIMEOUT) — so a rank restarted on the same host:port
+        rejoins the fan-out without rebuilding the IndexClient."""
         with self._lock:
+            if self._shutdown:
+                raise RuntimeError(f"client to {self.host}:{self.port} is closed")
+            if self._closed:
+                if time.time() < self._next_redial:
+                    raise ConnectionRefusedError(
+                        f"rank at {self.host}:{self.port} is down "
+                        "(redial cooldown)")
+                try:
+                    self._connect(self.RECONNECT_TIMEOUT)
+                except OSError:
+                    self._next_redial = time.time() + self.REDIAL_COOLDOWN
+                    raise
+                self._closed = False
             if timeout is not None:
                 self.sock.settimeout(timeout)
             try:
                 send_frame(self.sock, KIND_CALL, (fname, tuple(args), kwargs or {}))
                 kind, payload = recv_frame(self.sock)
-            except (socket.timeout, TimeoutError):
+            except (OSError, EOFError):
+                # covers socket.timeout/TimeoutError (OSError subclasses)
+                # and mid-frame stream ends: a partial frame desyncs the
+                # stream, so the connection is unusable either way
                 self._closed = True
                 self.sock.close()
                 raise
@@ -218,6 +258,9 @@ class Client:
         return call
 
     def close(self):
+        if self._shutdown:
+            return
+        self._shutdown = True  # user-initiated: no auto-reconnect after this
         if self._closed:
             return
         self._closed = True

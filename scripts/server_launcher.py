@@ -17,7 +17,10 @@ import sys
 
 def get_args():
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--backend", choices=["local", "slurm"], default="local")
+    p.add_argument("--backend", choices=["local", "slurm"], default="local",
+                   help="slurm is EXPERIMENTAL: exercised only against a "
+                        "mocked submitit (none in this image); local is "
+                        "tested end-to-end (see docs/OPERATIONS.md)")
     p.add_argument("--discovery-config", required=True,
                    help="shared file: first line server count, then host,port lines")
     p.add_argument("--num-servers", type=int, required=True)

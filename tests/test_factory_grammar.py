@@ -163,6 +163,7 @@ def test_unknown_specs_still_raise():
 # ---- end-to-end behavior -------------------------------------------------
 
 
+@pytest.mark.slow  # ~10s; OPQ math covered by the rotation-reconstruction test
 def test_opq_end_to_end_recall(rng):
     x, q = corpus(rng)
     idx = build("OPQ8,IVF4,PQ8,RFlat")

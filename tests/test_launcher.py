@@ -137,6 +137,7 @@ def test_degraded_mode_search_with_dead_rank(tmp_path):
             assert time.time() - t0 < 120, "adds never indexed"
             time.sleep(0.2)
 
+        client.save_index("pidx")  # restart-from-storage needs a checkpoint
         # which ids each rank owns (stub order == discovery order); ports
         # are base_port + rank, so map the victim stub back to its process
         ids_per_stub = [stub.get_ids("pidx") for stub in client.sub_indexes]
@@ -163,11 +164,36 @@ def test_degraded_mode_search_with_dead_rank(tmp_path):
         for i in range(40):
             if i in surviving_ids:
                 assert metas[i][0] == (i,)
-        # a healthy cluster call reports no missing ranks... but the dead
-        # stub's socket stays dead — partial mode keeps skipping it
+        # still down on the next call: partial mode keeps skipping it
         scores2, metas2, missing2 = client.search(
             q, 5, "pidx", allow_partial=True, partial_timeout=15.0)
         assert len(missing2) == 1
+
+        # restart the victim rank on the SAME port: the stub redials on the
+        # next call (rpc.Client auto-reconnect) and, after a load_index
+        # broadcast restores its shard from storage, the cluster converges
+        # back to complete results on the ORIGINAL client
+        vrank = [r for r, i in client.index_rank_to_id.items() if i == victim][0]
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "distributed_faiss_tpu.parallel.server",
+             "--rank", str(vrank), "--port", str(victim_port),
+             "--storage-dir", storage],
+            env={**os.environ, **env},
+        ))
+        t0 = time.time()
+        while True:
+            try:
+                assert client.load_index("pidx", cfg, force_reload=False)
+                break
+            except OSError:
+                assert time.time() - t0 < 60, "restarted rank never came up"
+                time.sleep(0.3)
+        scores3, metas3, missing3 = client.search(
+            q, 5, "pidx", allow_partial=True, partial_timeout=15.0)
+        assert missing3 == []
+        for i in range(40):  # full corpus served again, incl. old dead ids
+            assert metas3[i][0] == (i,)
+        client.search(q, 5, "pidx")  # strict mode healthy again
         client.close()
     finally:
         for p in procs:

@@ -84,3 +84,34 @@ def test_sharded_ivf_search_at_65536_lists(rng):
     assert overlap >= 0.5, overlap
     # the self-neighbor must be found (its own centroid is always probed)
     assert all(gt[i][0] in ids[i] for i in range(len(q)))
+
+
+@pytest.mark.slow
+@pytest.mark.scale
+def test_sharded_kmeans_262144_tier(rng):
+    """The 262,144-centroid tier (corpora past 1e7 rows, reference
+    index.py:497-508) — never exercised before r4. Same invariants as the
+    65,536-tier test one tier up: the random-seed branch, the auto_chunk
+    byte bound, bounding-box containment, and no centroid collapse."""
+    from distributed_faiss_tpu.ops.kmeans import auto_chunk
+    from distributed_faiss_tpu.parallel.mesh import make_mesh, sharded_kmeans
+
+    k = 262_144
+    mesh = make_mesh()
+    # d=2 keeps the n x k x d assignment FLOPs tractable on the 1-core CPU
+    # suite; the tier's code paths (random seeding, chunk bound, psum
+    # accumulation shapes) do not depend on d
+    n, d = k + 4_096, 2
+    x = rng.standard_normal((n, d)).astype(np.float32)
+
+    chunk = auto_chunk(k, None)
+    assert chunk * k * 4 <= 2 ** 31
+
+    cent = np.asarray(sharded_kmeans(mesh, x, k, iters=1))
+    assert cent.shape == (k, d)
+    assert np.isfinite(cent).all()
+    lo, hi = x.min(0) - 1e-3, x.max(0) + 1e-3
+    assert (cent >= lo).all() and (cent <= hi).all()
+    sample = cent[rng.permutation(k)[:4096]]
+    dists = np.linalg.norm(sample[:-1] - sample[1:], axis=1)
+    assert np.median(dists) > 1e-4  # not collapsed onto one point

@@ -213,6 +213,7 @@ def test_sharded_ivf_pq_matches_single_device(rng, metric):
     np.testing.assert_allclose(Ds, Du, rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow  # 65-80s each on the 1-core box (suite time budget, r4)
 @pytest.mark.parametrize("metric", ["dot", "l2"])
 def test_routed_pq_matches_masked(rng, metric):
     from distributed_faiss_tpu.parallel.mesh import ShardedIVFPQIndex
@@ -299,6 +300,7 @@ def test_sharded_pq_refine_scores_are_exact(rng, routing):
         np.testing.assert_allclose(D[qi], exact, rtol=1e-3, atol=1e-2)
 
 
+@pytest.mark.slow  # ~18s pair; exactness covered by refine_scores_are_exact
 @pytest.mark.parametrize("routing", [False, True])
 def test_sharded_pq_refine_lifts_recall(rng, routing):
     """Same trained state, same nprobe: the refined sharded search must
@@ -388,6 +390,7 @@ def test_sharded_pq_refine_state_round_trip(rng, tmp_path):
     np.testing.assert_allclose(D0, D1, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow  # ~10s; the routed-path equality above covers correctness
 def test_routed_bucket_auto_resize_under_skew(rng, caplog):
     """Adversarial skew: every added row lands in ONE list, so one chip owns
     all (query, probe) pairs and the default 2x-slack bucket must drop.
