@@ -175,11 +175,30 @@ class IndexServer:
         """Liveness/health probe (the reference has no failure detection
         beyond startup backoff, SURVEY §5.3). get_state() runs outside
         indexes_lock so a long device call on one index can't stall the
-        registry (and with it every other RPC)."""
+        registry (and with it every other RPC).
+
+        ``kernels`` surfaces ADC runtime demotions (models/ivf.py
+        pallas_guarded): ``use_nibble`` is the process-wide nibble-kernel
+        flag, ``pallas_degraded`` lists indexes whose configured pallas
+        intent fell back to XLA on this backend — an operator's cue to
+        check the rank's logs before trusting its serving throughput."""
         with self.indexes_lock:
             snapshot = list(self.indexes.items())
         states = {iid: idx.get_state().name for iid, idx in snapshot}
-        return {"rank": self.rank, "indexes": states}
+        from distributed_faiss_tpu.ops import adc_pallas
+
+        degraded = []
+        for iid, idx in snapshot:
+            tpu_index = getattr(idx, "tpu_index", None)
+            if (getattr(tpu_index, "use_pallas", False)
+                    and not getattr(tpu_index, "_pallas_runtime_ok", True)):
+                degraded.append(iid)
+        return {
+            "rank": self.rank,
+            "indexes": states,
+            "kernels": {"use_nibble": adc_pallas.USE_NIBBLE,
+                        "pallas_degraded": degraded},
+        }
 
     def stop(self) -> None:
         logger.info("stopping server rank=%d", self.rank)
