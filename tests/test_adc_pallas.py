@@ -221,3 +221,31 @@ def test_pallas_degrade_ladder(rng, monkeypatch):
     assert not idx._pallas_runtime_ok
     np.testing.assert_array_equal(got_i, want_i)
     np.testing.assert_allclose(got_d, want_d, rtol=1e-4, atol=1e-4)
+
+
+def test_nibble_consumer_registry_complete():
+    """Every jitted program that bakes the adc_scan_auto dispatch in at
+    trace time must be registered, or disable_nibble leaves a stale
+    nibble executable behind and the ladder misattributes the next fault."""
+    from distributed_faiss_tpu.models import ivf as ivfmod
+    from distributed_faiss_tpu.parallel import mesh as meshmod
+
+    registered = {id(f) for f in adc_pallas.NIBBLE_JIT_CONSUMERS}
+    expected = [
+        ivfmod._ivf_pq_search, ivfmod._ivf_pq_search_fused,
+        meshmod._sharded_ivf_pq_search, meshmod._sharded_ivf_pq_search_fused,
+        meshmod._sharded_ivf_pq_search_routed,
+    ]
+    assert all(id(f) in registered for f in expected)
+    assert len(adc_pallas.NIBBLE_JIT_CONSUMERS) == len(expected)
+
+    # tripwire against silent drift: a NEW adc_scan_auto call site means a
+    # new (possibly unregistered) consumer — this count forces whoever adds
+    # one to register its enclosing jitted program(s) and update both lists
+    import inspect
+
+    sites = sum(inspect.getsource(mod).count("adc_scan_auto(")
+                for mod in (ivfmod, meshmod))
+    assert sites == 3, (
+        "adc_scan_auto call-site count changed: register the new consumer "
+        "in NIBBLE_JIT_CONSUMERS and update this test")
