@@ -117,6 +117,21 @@ def main():
     backend = jax.devices()[0].platform
     if os.environ.get("BENCH_BACKEND_NOTE"):
         backend = os.environ["BENCH_BACKEND_NOTE"]
+    result = format_result(
+        backend=backend, rec=rec, n=n, d=d, nprobe=nprobe,
+        build_s=build_s, tpu_qps=tpu_qps, cpu_qps=cpu_qps,
+    )
+    print(json.dumps(result))
+
+
+def format_result(*, backend, rec, n, d, nprobe, build_s, tpu_qps, cpu_qps):
+    """Assemble the driver-facing JSON artifact.
+
+    A dead relay must not yield an artifact whose vs_baseline reads as a perf
+    collapse (BENCH_r02..r04 all printed ~1.0 from the CPU fallback): on a
+    cpu-fallback backend the measured ratio stays visible in the metric label,
+    but the headline field is nulled and the artifact flagged degraded.
+    """
     result = {
         "metric": (
             f"IVF-fp16 search QPS @ recall@10={rec:.3f} "
@@ -126,7 +141,11 @@ def main():
         "unit": "qps",
         "vs_baseline": round(tpu_qps / cpu_qps, 2),
     }
-    print(json.dumps(result))
+    if backend.startswith("cpu-fallback"):
+        result["metric"] += f" [degraded; cpu ratio {result['vs_baseline']}]"
+        result["vs_baseline"] = None
+        result["backend_degraded"] = True
+    return result
 
 
 def _probe_backend(timeout_s: int = 180):

@@ -410,11 +410,14 @@ class Index:
 
     def get_ids(self) -> set:
         id_idx = self.cfg.custom_meta_id_idx
-        # id_to_metadata is extended under buffer_lock (add_index_data); take
-        # it here too so a concurrent add can't give a torn read (reference
-        # does the same, index.py:367-368)
+        # Snapshot under buffer_lock (torn-read guard, reference
+        # index.py:367-368), then build the set outside: the O(ntotal)
+        # Python iteration must not stall concurrent add_index_data. Safe
+        # because the store is append-only past the snapshotted length
+        # (_MetaStore docstring).
         with self.buffer_lock:
-            return {meta[id_idx] for meta in self.id_to_metadata if meta}
+            meta_arr, meta_n = self.id_to_metadata.snapshot()
+        return {meta[id_idx] for meta in meta_arr[:meta_n].tolist() if meta}
 
     def upd_cfg(self, cfg: IndexCfg) -> None:
         self.cfg = cfg

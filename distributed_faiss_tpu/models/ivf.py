@@ -22,6 +22,7 @@ lists); lists are rebuilt by one bulk append on load.
 
 import functools
 import logging
+import re
 from typing import Dict, Optional
 
 import jax
@@ -351,6 +352,16 @@ class _IVFBase(base.TpuIndex):
         compiles O(log max_batch) fused variants (each sharded variant is a
         multi-second compile) instead of one per distinct batch size —
         offline/bench callers with a stable batch size still compile once.
+
+        Memory cliff (ADVICE r4): the pow2 bucket can pad the fused batch
+        up to ~2x (33 blocks -> 64), doubling the stacked (nblocks, block,
+        d) query input and (nblocks*block, k') output arrays for that
+        launch. The per-block score/gather transients — the dominant
+        footprint, bounded by ``pick_query_block``'s budget — are NOT
+        inflated (``lax.map`` runs blocks sequentially), so the cliff is
+        a few MB of query/output padding, not a doubled working set;
+        callers pinning their own batch sizes can stay at power-of-two
+        multiples of the block to avoid even that.
         """
         q = np.asarray(q, np.float32)
         nq = q.shape[0]
@@ -542,9 +553,44 @@ def disable_nibble(m: int, ksub: int) -> bool:
         if not _adc_pallas.USE_NIBBLE:
             return False  # already demoted; caches already cleared
         _adc_pallas.USE_NIBBLE = False
+        _adc_pallas.NIBBLE_SWEEP_EPOCH += 1
         for fn in _adc_pallas.NIBBLE_JIT_CONSUMERS:
             fn.clear_cache()
     return True
+
+
+def _norm_msg(e: Exception) -> str:
+    """Exception text with the unstable parts (hex addresses, digit runs —
+    buffer ids, byte counts) masked out."""
+    return re.sub(r"0x[0-9a-fA-F]+|\d+", "#", str(e))
+
+
+def _same_failure(a: Exception, b: Exception) -> bool:
+    """Conservative "same failure" test for oracle-vs-kernel attribution.
+
+    One bad request can raise with differently-phrased text on the pallas
+    and XLA jit variants (backend wording, embedded addresses / buffer ids),
+    so raw string equality under-matches and a single bad client request
+    could demote the nibble kernel process-wide and trigger a full
+    clear_cache sweep (ADVICE r4). Compare the exception type plus the
+    normalized message.
+    """
+    return type(a) is type(b) and _norm_msg(a) == _norm_msg(b)
+
+
+# pallas_guarded (oracle-failure branch): normalized signatures of every
+# request on which BOTH paths failed while the nibble kernel was on. A
+# repeat of a seen signature demotes the nibble kernel (a broken kernel
+# fails identically every time, and a set survives unrelated bad requests
+# interleaving with it); distinct signatures never accumulate toward a
+# demotion. Known tradeoff: two same-kind bad requests differing only in
+# numerics (masked by _norm_msg) share a signature and spuriously demote —
+# bounded cost (one sweep, monotone) accepted to keep a broken kernel whose
+# oracle failure mirrors it from re-faulting forever. Capped: a process
+# accumulating 16 distinct both-failed signatures with nibble on is
+# systematically unhealthy — treat overflow as a repeat.
+_BOTH_FAILED_SIGS = set()
+_BOTH_FAILED_CAP = 16
 
 
 def pallas_guarded(index, call, m: int, ksub: int):
@@ -558,18 +604,27 @@ def pallas_guarded(index, call, m: int, ksub: int):
     by the nibble state captured BEFORE the call: USE_NIBBLE is monotone
     (never restored), so nibble_was_on means the failing executable may
     have baked the nibble kernel in — demote nibble only and let the next
-    search try the one-hot pallas kernel; nibble_was_off blames the
-    one-hot kernel, but only after it fails a FRESH trace (an in-flight
-    trace started before a concurrent demotion can re-insert a stale
-    nibble executable after the sweep). A broken one-hot behind a broken
-    nibble therefore converges within two failing searches, each serving
-    its caller from the XLA result in hand.
+    search try the one-hot pallas kernel; nibble_was_off may still be a
+    stale pre-demotion executable (an in-flight trace started before a
+    concurrent demotion can re-insert one after the sweep) — excused when
+    the sweep epoch moved since this call started (any number of in-flight
+    pre-demotion calls) or via the one NIBBLE_SWEPT excuse (a post-sweep
+    call hitting a late re-inserted executable): sweep again, serve the
+    XLA result, and let the next search run a fresh trace. A failure that
+    started after the latest sweep with the excuse spent blames the
+    one-hot kernel itself, and a bounded excuse budget
+    (NIBBLE_EXCUSES_LEFT) keeps concurrent excuse sweeps from excusing
+    each other forever. A broken one-hot behind a broken nibble therefore
+    converges within NIBBLE_EXCUSES_LEFT + 2 failing searches even under
+    constant concurrency, each serving its caller from the XLA result in
+    hand, with no synchronous re-trace inside any request.
     ``index`` provides use_pallas/_pallas_runtime_ok; every attempt runs
     under ``jax.block_until_ready`` so asynchronous kernel aborts surface
     here, not at a later np.asarray.
     """
     with_pallas = index.use_pallas and index._pallas_runtime_ok
     nibble_was_on = _adc_pallas.USE_NIBBLE
+    epoch0 = _adc_pallas.NIBBLE_SWEEP_EPOCH
     try:
         out = call(with_pallas)
         jax.block_until_ready(out)
@@ -586,20 +641,35 @@ def pallas_guarded(index, call, m: int, ksub: int):
         except Exception as oracle_err:
             # the same failure on both paths = the request itself is bad
             # (a dim mismatch raises in the shared coarse-scoring prefix):
-            # re-raise with no flag flips and no cache wipes, so a
-            # misbehaving client cannot evict healthy compiled variants. A
-            # DIFFERENT oracle failure (say the XLA path OOMs materializing
-            # the one-hot the pallas kernel exists to avoid) does NOT
-            # exonerate the nibble kernel — demote it so the next search
-            # tries the one-hot pallas rung instead of re-faulting forever.
-            if (nibble_eligible and nibble_was_on
-                    and str(oracle_err) != str(kernel_err)):
-                disable_nibble(m, ksub)
-                logger.exception(
-                    "pallas ADC failure plus a distinct XLA-oracle failure: "
-                    "nibble demoted; the one-hot pallas kernel runs from "
-                    "the next search on"
-                )
+            # re-raise with no flag flips and no cache wipes, so ONE
+            # misbehaving client request cannot evict healthy compiled
+            # variants. A DIFFERENT oracle failure (say the XLA path OOMs
+            # materializing the one-hot the pallas kernel exists to avoid)
+            # does NOT exonerate the nibble kernel — demote it so the next
+            # search tries the one-hot pallas rung instead of re-faulting
+            # forever. _same_failure is a textual heuristic, so a kernel
+            # fault whose oracle failure mirrors it after normalization
+            # (e.g. two OOMs differing only in byte counts) can look like
+            # a bad request: grant that reading once PER SIGNATURE, then
+            # demote when a seen signature repeats — never-demoting would
+            # re-fault every search forever, while a spurious demotion (a
+            # client retrying one malformed request, or two same-kind bad
+            # requests whose numerics normalize equal — see
+            # _BOTH_FAILED_SIGS) costs one cache sweep per process,
+            # bounded by the monotone flag.
+            if nibble_eligible and nibble_was_on:
+                sig = (type(kernel_err).__name__, _norm_msg(kernel_err))
+                with _adc_pallas.NIBBLE_LOCK:
+                    repeat = (sig in _BOTH_FAILED_SIGS
+                              or len(_BOTH_FAILED_SIGS) >= _BOTH_FAILED_CAP)
+                    _BOTH_FAILED_SIGS.add(sig)
+                if not _same_failure(oracle_err, kernel_err) or repeat:
+                    disable_nibble(m, ksub)
+                    logger.exception(
+                        "pallas ADC failure plus an XLA-oracle failure "
+                        "(distinct or repeated): nibble demoted; the "
+                        "one-hot pallas kernel runs from the next search on"
+                    )
             raise
         if nibble_eligible and nibble_was_on:
             disable_nibble(m, ksub)
@@ -612,21 +682,42 @@ def pallas_guarded(index, call, m: int, ksub: int):
         if nibble_eligible:
             # nibble was already off at call time — but an executable traced
             # BEFORE a concurrent demotion can land in the cache after its
-            # sweep, still baking the nibble kernel in. Blame the one-hot
-            # kernel only after it fails a FRESH trace.
-            for fn in _adc_pallas.NIBBLE_JIT_CONSUMERS:
-                fn.clear_cache()
-            try:
-                out2 = call(True)
-                jax.block_until_ready(out2)
+            # sweep, still baking the nibble kernel in. Excuse the failure
+            # (sweep the caches again and serve the XLA result already in
+            # hand — ADVICE r4: a synchronous pallas re-trace here inflated
+            # the request's latency by multi-second compiles just to probe
+            # kernel health) when this call may have raced such a stale
+            # executable: either a sweep happened after this call started
+            # (epoch moved — covers ANY number of in-flight pre-demotion
+            # calls), or the once-per-process NIBBLE_SWEPT excuse is unused
+            # (covers a call that started after the sweep but hit an
+            # executable re-inserted by a completing pre-demotion trace,
+            # which the epoch cannot see). A call that started after the
+            # latest sweep with the excuse spent ran a genuinely fresh
+            # one-hot trace — fall through to the pallas demotion below.
+            with _adc_pallas.NIBBLE_LOCK:
+                # the excuse budget bounds the epoch rule under concurrency:
+                # each excuse sweep moves the epoch, which would excuse every
+                # call that entered before it — without the cap, >=2 requests
+                # permanently in flight against a genuinely broken one-hot
+                # kernel would excuse each other forever (r5 review)
+                excused = ((_adc_pallas.NIBBLE_SWEEP_EPOCH > epoch0
+                            or not _adc_pallas.NIBBLE_SWEPT)
+                           and _adc_pallas.NIBBLE_EXCUSES_LEFT > 0)
+                if excused:
+                    _adc_pallas.NIBBLE_EXCUSES_LEFT -= 1
+                    _adc_pallas.NIBBLE_SWEPT = True
+                    _adc_pallas.NIBBLE_SWEEP_EPOCH += 1
+                    for fn in _adc_pallas.NIBBLE_JIT_CONSUMERS:
+                        fn.clear_cache()
+            if excused:
                 logger.exception(
-                    "pallas ADC failure came from a stale pre-demotion "
-                    "executable; a fresh one-hot trace works (pallas stays "
-                    "active)"
+                    "pallas ADC failure with nibble already demoted — "
+                    "possibly a stale pre-demotion executable; caches "
+                    "swept, this request served via XLA, the next search "
+                    "runs a fresh one-hot trace"
                 )
-                return out2
-            except Exception:
-                pass
+                return out
         logger.exception(
             "pallas ADC (one-hot) kernel failed on this backend; using "
             "the XLA path for the rest of this process (persisted "

@@ -302,6 +302,23 @@ NIBBLE_JIT_CONSUMERS = []
 # models/ivf.py) so concurrent searches demote exactly once
 NIBBLE_LOCK = threading.Lock()
 
+# post-demotion stale-executable accounting (models.ivf.pallas_guarded,
+# both mutated under NIBBLE_LOCK): NIBBLE_SWEEP_EPOCH counts cache sweeps
+# (the demotion sweep and every excuse sweep); a failing call that STARTED
+# before the latest sweep may have raced a stale executable and is excused.
+# NIBBLE_SWEPT additionally grants one excuse to a call that started after
+# the last sweep but picked up an executable re-inserted by an in-flight
+# pre-demotion trace (a completing trace is invisible to the epoch).
+NIBBLE_SWEEP_EPOCH = 0
+NIBBLE_SWEPT = False
+
+# bounded excuse budget: each excuse sweep moves the epoch, which itself
+# excuses concurrent in-flight calls — under constant concurrency a
+# genuinely broken one-hot kernel could otherwise be excused forever. The
+# cap covers any realistic in-flight count while guaranteeing the ladder
+# converges to the XLA path within NIBBLE_EXCUSES + 2 failing searches.
+NIBBLE_EXCUSES_LEFT = 8
+
 
 def adc_scan_shared_auto(lut, codes, tile: int = DEFAULT_TILE):
     """Pallas on TPU, interpreter elsewhere (tests run the kernel on CPU)."""

@@ -226,15 +226,22 @@ class Client:
                     self._next_redial = time.time() + self.REDIAL_COOLDOWN
                     raise
                 self._closed = False
+            # pack BEFORE touching the socket: a client-side pickling failure
+            # (unpicklable argument) must raise without tearing down a
+            # healthy connection — zero bytes have hit the wire
+            parts = pack_frame(KIND_CALL, (fname, tuple(args), kwargs or {}))
             if timeout is not None:
                 self.sock.settimeout(timeout)
             try:
-                send_frame(self.sock, KIND_CALL, (fname, tuple(args), kwargs or {}))
+                _send_parts(self.sock, parts)
                 kind, payload = recv_frame(self.sock)
-            except (OSError, EOFError):
-                # covers socket.timeout/TimeoutError (OSError subclasses)
-                # and mid-frame stream ends: a partial frame desyncs the
-                # stream, so the connection is unusable either way
+            except Exception:
+                # OSError/EOFError (socket timeouts, mid-frame stream ends)
+                # but also RuntimeError("bad frame magic") and unpickling
+                # failures (ADVICE r4): any mid-frame failure leaves the
+                # stream position unknown, so the connection must never be
+                # reused — drop it and let the NEXT call redial cleanly
+                # instead of serving garbage from a desynced stream.
                 self._closed = True
                 self.sock.close()
                 raise

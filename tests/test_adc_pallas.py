@@ -199,6 +199,9 @@ def test_pallas_degrade_ladder(rng, monkeypatch):
     # drop compiled variants so the injected failure is actually reached
     ivfmod._ivf_pq_search.clear_cache()
     monkeypatch.setattr(adc_pallas, "USE_NIBBLE", True)
+    monkeypatch.setattr(adc_pallas, "NIBBLE_SWEPT", False)
+    monkeypatch.setattr(adc_pallas, "NIBBLE_EXCUSES_LEFT", 8)
+    monkeypatch.setattr(ivfmod, "_BOTH_FAILED_SIGS", set())
     monkeypatch.setattr(adc_pallas, "adc_scan_pallas_nibble", boom)
 
     # a user error (bad dim) re-raises from the XLA oracle with every
@@ -214,9 +217,17 @@ def test_pallas_degrade_ladder(rng, monkeypatch):
     np.testing.assert_array_equal(got_i, want_i)
     np.testing.assert_allclose(got_d, want_d, rtol=1e-4, atol=1e-4)
 
-    # now the one-hot kernel breaks too -> XLA path, pallas disabled
+    # now the one-hot kernel breaks too. The first failure is excused as a
+    # possible stale pre-demotion executable (ADVICE r4: caches swept, the
+    # request served from the XLA result in hand, NO synchronous re-trace);
+    # the second failure — necessarily a fresh trace — demotes pallas.
     ivfmod._ivf_pq_search.clear_cache()
     monkeypatch.setattr(adc_pallas, "adc_scan_pallas", boom)
+    got_d, got_i = idx.search(q, 5)
+    assert idx._pallas_runtime_ok, "demoted on the excusable first failure"
+    assert adc_pallas.NIBBLE_SWEPT is True
+    np.testing.assert_array_equal(got_i, want_i)
+    np.testing.assert_allclose(got_d, want_d, rtol=1e-4, atol=1e-4)
     got_d, got_i = idx.search(q, 5)
     assert not idx._pallas_runtime_ok
     np.testing.assert_array_equal(got_i, want_i)
@@ -249,3 +260,100 @@ def test_nibble_consumer_registry_complete():
     assert sites == 3, (
         "adc_scan_auto call-site count changed: register the new consumer "
         "in NIBBLE_JIT_CONSUMERS and update this test")
+
+
+def test_both_failed_repeat_demotes_nibble(monkeypatch):
+    """When kernel AND oracle fail with messages that normalize equal (e.g.
+    OOMs differing only in byte counts), the first request is read as 'bad
+    request' (no demotion, no cache wipe), but a repeat of the SAME failure
+    signature demotes the nibble kernel — never-demoting would re-fault
+    every search forever. Distinct bad requests never accumulate."""
+    from distributed_faiss_tpu.models import ivf as ivfmod
+
+    class FakeIdx:
+        use_pallas = True
+        _pallas_runtime_ok = True
+
+    def oom_call(use_pallas):
+        if use_pallas:
+            raise RuntimeError("RESOURCE_EXHAUSTED allocating 8589934592 bytes")
+        raise RuntimeError("RESOURCE_EXHAUSTED allocating 17179869184 bytes")
+
+    def other_bad_call(use_pallas):
+        raise RuntimeError("dim mismatch: got 33, want 32")
+
+    monkeypatch.setattr(adc_pallas, "USE_NIBBLE", True)
+    monkeypatch.setattr(ivfmod, "_BOTH_FAILED_SIGS", set())
+    assert adc_pallas.nibble_supported(8, 256)
+
+    with pytest.raises(RuntimeError):
+        ivfmod.pallas_guarded(FakeIdx(), oom_call, 8, 256)
+    assert adc_pallas.USE_NIBBLE is True, "one bad request must not demote"
+
+    # a DIFFERENT bad request in between must not count toward the repeat
+    with pytest.raises(RuntimeError):
+        ivfmod.pallas_guarded(FakeIdx(), other_bad_call, 8, 256)
+    assert adc_pallas.USE_NIBBLE is True, "distinct signatures accumulated"
+
+    # the OOM signature repeating demotes — the interleaved unrelated bad
+    # request must NOT have displaced it (signature set, not single slot)
+    with pytest.raises(RuntimeError):
+        ivfmod.pallas_guarded(FakeIdx(), oom_call, 8, 256)
+    assert adc_pallas.USE_NIBBLE is False, "repeated signature must demote"
+
+    # genuinely distinct failures demote immediately (reset state first)
+    monkeypatch.setattr(adc_pallas, "USE_NIBBLE", True)
+    monkeypatch.setattr(ivfmod, "_BOTH_FAILED_SIGS", set())
+
+    def distinct_call(use_pallas):
+        if use_pallas:
+            raise RuntimeError("kernel abort")
+        raise ValueError("one-hot materialization OOM")
+
+    with pytest.raises(ValueError):
+        ivfmod.pallas_guarded(FakeIdx(), distinct_call, 8, 256)
+    assert adc_pallas.USE_NIBBLE is False
+
+
+def test_stale_executable_excuse_covers_concurrent_inflight(monkeypatch):
+    """Two in-flight searches whose traces predate a concurrent nibble
+    demotion must BOTH be excused (served via XLA, pallas kept) — the sweep
+    epoch moves under the first excuse, covering the second (r5 review)."""
+    from distributed_faiss_tpu.models import ivf as ivfmod
+
+    class FakeIdx:
+        use_pallas = True
+        _pallas_runtime_ok = True
+
+    monkeypatch.setattr(adc_pallas, "USE_NIBBLE", False)  # demotion landed
+    monkeypatch.setattr(adc_pallas, "NIBBLE_SWEPT", True)  # excuse spent
+    monkeypatch.setattr(adc_pallas, "NIBBLE_EXCUSES_LEFT", 2)
+    epoch0 = adc_pallas.NIBBLE_SWEEP_EPOCH
+    monkeypatch.setattr(adc_pallas, "NIBBLE_SWEEP_EPOCH", epoch0)
+
+    # pallas_guarded captures the epoch at entry; emulate "this call's trace
+    # started before the concurrent demotion's sweep" by rewinding the epoch
+    # before each entry and bumping it from inside the failing pallas call
+    # (the moment the demotion sweep would land)
+    def stale_exec(use_pallas):
+        if use_pallas:
+            adc_pallas.NIBBLE_SWEEP_EPOCH = epoch0 + 1
+            raise RuntimeError("stale nibble executable abort")
+        return "xla-result"
+
+    idx_a, idx_b = FakeIdx(), FakeIdx()
+    adc_pallas.NIBBLE_SWEEP_EPOCH = epoch0
+    assert ivfmod.pallas_guarded(idx_a, stale_exec, 8, 256) == "xla-result"
+    assert idx_a._pallas_runtime_ok, "in-flight stale executable demoted pallas"
+    adc_pallas.NIBBLE_SWEEP_EPOCH = epoch0
+    assert ivfmod.pallas_guarded(idx_b, stale_exec, 8, 256) == "xla-result"
+    assert idx_b._pallas_runtime_ok, "second in-flight victim demoted pallas"
+
+    # budget exhausted: a further "stale-looking" failure is no longer
+    # excused — a genuinely broken one-hot kernel under constant concurrency
+    # must converge to the XLA path, not excuse itself forever (r5 review)
+    assert adc_pallas.NIBBLE_EXCUSES_LEFT == 0
+    idx_c = FakeIdx()
+    adc_pallas.NIBBLE_SWEEP_EPOCH = epoch0
+    assert ivfmod.pallas_guarded(idx_c, stale_exec, 8, 256) == "xla-result"
+    assert idx_c._pallas_runtime_ok is False, "budget spent yet still excused"

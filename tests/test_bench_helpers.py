@@ -58,3 +58,25 @@ def test_recall_and_exact_helpers_agree():
     gt = _exact_topk(x, q, 5, "l2")
     assert recall_at_k(gt, gt, 5) == 1.0
     assert cpu_exact_qps(x, q, 5, "l2") > 0
+
+
+def test_bench_artifact_degraded_on_cpu_fallback():
+    """A relay-death fallback must flag itself instead of printing a ratio
+    that reads as a perf regression (BENCH_r02..r04 all showed ~1.0)."""
+    import bench
+
+    degraded = bench.format_result(
+        backend="cpu-fallback(TPU relay unavailable)", rec=0.96, n=50_000,
+        d=128, nprobe=8, build_s=12.0, tpu_qps=900.0, cpu_qps=910.0,
+    )
+    assert degraded["backend_degraded"] is True
+    assert degraded["vs_baseline"] is None
+    assert "degraded" in degraded["metric"]
+    assert "0.99" in degraded["metric"]  # ratio stays inspectable
+
+    healthy = bench.format_result(
+        backend="tpu", rec=0.96, n=500_000, d=128, nprobe=8,
+        build_s=30.0, tpu_qps=9000.0, cpu_qps=900.0,
+    )
+    assert "backend_degraded" not in healthy
+    assert healthy["vs_baseline"] == 10.0

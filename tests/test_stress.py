@@ -145,3 +145,42 @@ def test_concurrent_drop_during_add(rng):
         while idx.get_state() != IndexState.TRAINED and time.time() < deadline:
             time.sleep(0.02)
         assert idx.get_state() == IndexState.TRAINED
+
+
+def test_get_ids_does_not_stall_adds_on_large_store():
+    """get_ids builds its set OUTSIDE buffer_lock: a 1e6-row metadata store
+    must not stall a concurrent add_batch for the duration of the O(ntotal)
+    Python iteration (VERDICT r4 weak #5)."""
+    idx = Index(IndexCfg(index_builder_type="flat", dim=8, metric="l2",
+                         train_num=10**9, buffer_bsz=10**9))
+    n = 1_000_000
+    idx.id_to_metadata.extend((i, f"m{i}") for i in range(n))
+
+    t0 = time.time()
+    ids = idx.get_ids()
+    get_ids_s = time.time() - t0
+    assert len(ids) == n
+
+    done = threading.Event()
+    waits = []
+
+    def prober():
+        while not done.is_set():
+            t = time.time()
+            with idx.buffer_lock:
+                pass
+            waits.append(time.time() - t)
+            time.sleep(0.001)
+
+    p = threading.Thread(target=prober)
+    p.start()
+    for _ in range(3):
+        idx.get_ids()
+    done.set()
+    p.join()
+
+    # the lock is held only for the (array ref, length) snapshot — even with
+    # the whole-store iteration in flight, a waiter must get through orders
+    # of magnitude faster than one full get_ids pass
+    assert waits, "prober never ran"
+    assert max(waits) < max(0.05, get_ids_s / 4), (max(waits), get_ids_s)
