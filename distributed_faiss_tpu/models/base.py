@@ -104,6 +104,34 @@ def _scatter_lists(flat_data, flat_ids, pos, payload, gids):
     return flat_data, flat_ids
 
 
+@jax.jit
+def _gather_flat_rows(data, fidx):
+    """Fetch rows at flat (slot * cap + pos) cell addresses from a padded
+    (nlist, cap, *payload) list array (local or mesh-sharded — XLA inserts
+    the collectives for the sharded case)."""
+    return data.reshape((-1,) + data.shape[2:])[fidx]
+
+
+def gather_list_rows(lists, assign, pos, bucket_min: int = 1024) -> np.ndarray:
+    """Host-side driver: rows at (list, within-list position) pairs.
+
+    This is how reconstruct/persistence read payload back from device lists
+    instead of a host-RAM corpus mirror (VERDICT r4): flat cell addresses
+    are built from the id -> (list, pos) map, bucket-padded to bound jit
+    variants, and gathered in one launch.
+    """
+    n = assign.shape[0]
+    if n == 0:
+        return np.zeros((0,) + tuple(lists.payload_shape), lists.dtype)
+    flat = np.asarray(lists.slot_of(np.asarray(assign, np.int64))) * lists.cap \
+        + np.asarray(pos, np.int64)
+    bucket = _next_pow2(n, bucket_min)
+    fidx = np.zeros(bucket, np.int64)
+    fidx[:n] = flat
+    out = np.asarray(_gather_flat_rows(lists.data, jnp.asarray(fidx)))
+    return out[:n]
+
+
 class PaddedLists:
     """nlist growable inverted lists as rectangular padded device arrays."""
 
@@ -148,7 +176,11 @@ class PaddedLists:
         ``slot_fn(list) * cap + current_size + within-batch-offset``, and
         pads everything to a power-of-two bucket (padding rows get
         ``drop_value`` so the device scatter drops them). Returns
-        (counts, pos, payload, gids) with the latter three bucket-padded.
+        (counts, pos, payload, gids, within) with pos/payload/gids
+        bucket-padded and ``within`` the per-row within-list positions in
+        INPUT order — the id -> (list, slot) map that lets reconstruction
+        and persistence read rows back from the device lists instead of
+        keeping a host-RAM corpus mirror (VERDICT r4).
         """
         n = list_idx.shape[0]
         counts = np.bincount(list_idx, minlength=nlist)
@@ -157,7 +189,10 @@ class PaddedLists:
         group_start = np.zeros(nlist + 1, np.int64)
         group_start[1:] = np.cumsum(counts)
         offs = np.arange(n, dtype=np.int64) - group_start[sorted_li]
-        pos = slot_fn(sorted_li.astype(np.int64)) * cap + sizes_host[sorted_li] + offs
+        within_sorted = sizes_host[sorted_li] + offs
+        pos = slot_fn(sorted_li.astype(np.int64)) * cap + within_sorted
+        within = np.empty(n, np.int32)
+        within[order] = within_sorted.astype(np.int32)
 
         bucket = _next_pow2(n, bucket_min)
         pos_b = np.full(bucket, drop_value, np.int64)
@@ -166,21 +201,27 @@ class PaddedLists:
         pos_b[:n] = pos
         pay_b[:n] = payload[order]
         gid_b[:n] = gids[order]
-        return counts, pos_b, pay_b, gid_b
+        return counts, pos_b, pay_b, gid_b, within
+
+    def slot_of(self, l):
+        """global list id -> padded slot (identity locally; the sharded
+        variant overrides with strided ownership)."""
+        return l
 
     def append(self, list_idx: np.ndarray, payload: np.ndarray, gids: np.ndarray):
         """Append payload rows to their assigned lists.
 
         list_idx: (n,) int; payload: (n, *payload_shape); gids: (n,) global ids.
         Offset planning is host-side numpy; the device side is one scatter.
+        Returns the (n,) int32 within-list positions in input order.
         """
         if list_idx.shape[0] == 0:
-            return
+            return np.zeros(0, np.int32)
         counts = np.bincount(list_idx, minlength=self.nlist)
         new_sizes = self.sizes_host + counts
         if new_sizes.max() > self.cap:
             self._grow(int(new_sizes.max()))
-        counts, pos_b, pay_b, gid_b = self.plan_append(
+        counts, pos_b, pay_b, gid_b, within = self.plan_append(
             list_idx, payload, gids, self.nlist, self.cap, self.sizes_host,
             self.payload_shape, self.dtype, lambda l: l,
             np.iinfo(np.int32).max, self.APPEND_BUCKET,
@@ -195,6 +236,7 @@ class PaddedLists:
         self.ids = flat_ids.reshape(self.nlist, self.cap)
         self.sizes_host = new_sizes
         self._sizes_dev = jnp.asarray(new_sizes.astype(np.int32))
+        return within
 
 
 class TpuIndex:

@@ -128,11 +128,16 @@ def _build_hnswsq(cfg: IndexCfg):
 
     if hnsw.native_available():
         # defaults mirror the reference's hnswsq builder (index.py:55-58):
-        # store_n=128 graph degree, efConstruction=100
+        # store_n=128 graph degree, efConstruction=100. refine_k_factor=8
+        # (fp16 exact rescore of the SQ8 shortlist) is ON by default: the
+        # bare SQ8 codec plateaus ~0.90 recall (shared with the reference's
+        # IndexHNSWSQ) and the rerank is what clears the 0.95 bar — set
+        # extra={'refine_k_factor': 0} for reference-exact behavior
         return hnsw.HNSWSQIndex(
             cfg.dim, "l2",
             M=int(cfg.extra.get("store_n", 128)),
             ef_construction=int(cfg.extra.get("ef_construction", 100)),
+            refine_k_factor=int(cfg.extra.get("refine_k_factor", 8)),
         )
     # no C++ toolchain: exact sq8 scan keeps the builder slot working
     return FlatIndex(cfg.dim, "l2", codec="sq8")
@@ -315,6 +320,7 @@ def _build_hnsw_spec(M: int, dim: int, cfg: IndexCfg):
         return hnsw.HNSWSQIndex(
             dim, "l2", M=M,
             ef_construction=int(cfg.extra.get("ef_construction", 100)),
+            refine_k_factor=int(cfg.extra.get("refine_k_factor", 8)),
         )
     return FlatIndex(dim, "l2", codec="sq8")
 

@@ -15,9 +15,12 @@ index.py:25-33): argmax inner product for metric=dot, argmin L2 otherwise.
 PQ encoding is residual for l2 (FAISS IVFPQ by_residual) and raw for dot
 (FAISS disables residual PQ for IP).
 
-Host mirrors: insertion-order payload + assignment arrays are kept in host
-RAM for reconstruct_batch and persistence (device HBM holds only the padded
-lists); lists are rebuilt by one bulk append on load.
+Host state is the id -> (list, within-list position) map only (8 bytes/row):
+the payload lives solely in the device lists, and reconstruct_batch /
+persistence gather it back through that map (base.gather_list_rows). The
+previous design also mirrored the full encoded corpus in host RAM; at the
+reference knnlm scale (1e9 x 768) that second copy was terabytes (VERDICT
+r4). Lists are rebuilt by one bulk append on load.
 """
 
 import functools
@@ -272,9 +275,14 @@ class _IVFBase(base.TpuIndex):
         self.kmeans_iters = kmeans_iters
         self.centroids = None  # jnp (nlist, d)
         self.lists: Optional[base.PaddedLists] = None
-        # insertion-order host mirrors (reconstruct + persistence)
-        self._host_rows = []  # list of np chunks, payload rows in id order
-        self._host_assign = []  # list of np chunks, list idx in id order
+        # id -> (list, within-list position) map, the ONLY per-row host
+        # state (8 bytes/row). Payload lives solely in the device lists;
+        # reconstruct and persistence gather it back through this map
+        # (VERDICT r4: the previous insertion-order payload mirror put the
+        # whole corpus in host RAM a second time — ~1.5 TB at the reference
+        # knnlm scale of 1e9 x 768 fp16).
+        self._host_assign = []  # list of np int32 chunks, list idx in id order
+        self._host_pos = []  # list of np int32 chunks, within-list slot in id order
         self._n = 0
 
     @property
@@ -321,21 +329,40 @@ class _IVFBase(base.TpuIndex):
         assign = self._assign_host(x)
         rows = self._encode(x, assign)
         gids = np.arange(self._n, self._n + x.shape[0], dtype=np.int64)
-        self.lists.append(assign, rows, gids)
+        pos = self.lists.append(assign, rows, gids)
         self._append_extra(x, assign, gids)
-        self._host_rows.append(rows)
-        self._host_assign.append(assign)
+        self._host_assign.append(assign.astype(np.int32))
+        self._host_pos.append(pos)
         self._n += x.shape[0]
-
-    def _host_rows_array(self) -> np.ndarray:
-        if len(self._host_rows) > 1:
-            self._host_rows = [np.concatenate(self._host_rows)]
-        return self._host_rows[0] if self._host_rows else np.zeros((0,), np.float32)
 
     def _host_assign_array(self) -> np.ndarray:
         if len(self._host_assign) > 1:
             self._host_assign = [np.concatenate(self._host_assign)]
-        return self._host_assign[0] if self._host_assign else np.zeros((0,), np.int64)
+        return self._host_assign[0] if self._host_assign else np.zeros((0,), np.int32)
+
+    def _host_pos_array(self) -> np.ndarray:
+        if len(self._host_pos) > 1:
+            self._host_pos = [np.concatenate(self._host_pos)]
+        return self._host_pos[0] if self._host_pos else np.zeros((0,), np.int32)
+
+    def _device_rows(self, ids: np.ndarray) -> np.ndarray:
+        """Stored payload rows (encoded) for global ids, gathered from the
+        device lists — one bucketed launch, no host corpus mirror."""
+        ids = np.asarray(ids, np.int64)
+        return base.gather_list_rows(
+            self.lists, self._host_assign_array()[ids], self._host_pos_array()[ids]
+        )
+
+    def _rows_in_insertion_order(self, chunk: int = 1 << 20) -> np.ndarray:
+        """Stream the full encoded payload back from device in id order
+        (persistence). Host cost is the output array itself — the same bytes
+        the save file needs — plus one chunk of gather transients."""
+        out = np.zeros((self._n,) + tuple(self.lists.payload_shape),
+                       self.lists.dtype)
+        for s in range(0, self._n, chunk):
+            e = min(self._n, s + chunk)
+            out[s:e] = self._device_rows(np.arange(s, e, dtype=np.int64))
+        return out
 
     def _search_blocks(self, q: np.ndarray, k: int, fn, block: int = 256,
                        fused_fn=None):
@@ -482,7 +509,7 @@ class IVFFlatIndex(_IVFBase):
         return self._search_blocks(q, k, run, block=nb, fused_fn=run_fused)
 
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
-        rows = self._host_rows_array()[np.asarray(ids, np.int64)]
+        rows = self._device_rows(ids)
         if self.codec == "sq8":
             return np.asarray(sq.sq8_decode(jnp.asarray(rows), self.sq_params["vmin"], self.sq_params["span"]))
         return rows.astype(np.float32)
@@ -500,7 +527,7 @@ class IVFFlatIndex(_IVFBase):
         }
         if self.is_trained:
             state["centroids"] = np.asarray(self.centroids)
-            state["rows"] = self._host_rows_array()
+            state["rows"] = self._rows_in_insertion_order()
             state["assign"] = self._host_assign_array()
             if self.sq_params is not None:
                 state["sq_vmin"] = np.asarray(self.sq_params["vmin"])
@@ -522,9 +549,9 @@ class IVFFlatIndex(_IVFBase):
         idx.lists = base.PaddedLists(idx.nlist, (idx.dim,), cls._DTYPES[idx.codec])
         rows, assign = state["rows"], state["assign"]
         if rows.shape[0]:
-            idx.lists.append(assign, rows, np.arange(rows.shape[0], dtype=np.int64))
-            idx._host_rows = [rows]
-            idx._host_assign = [assign]
+            pos = idx.lists.append(assign, rows, np.arange(rows.shape[0], dtype=np.int64))
+            idx._host_assign = [assign.astype(np.int32)]
+            idx._host_pos = [pos]
             idx._n = rows.shape[0]
             if idx.refine_store is not None:
                 idx.refine_store.add(np.asarray(state["refine_rows"], np.float16))
@@ -838,7 +865,7 @@ class IVFPQIndex(_IVFBase):
 
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
         ids = np.asarray(ids, np.int64)
-        codes = self._host_rows_array()[ids]
+        codes = self._device_rows(ids)
         rec = np.asarray(pq.pq_decode(jnp.asarray(codes), self.codebooks))
         if self.metric == "l2":
             assign = self._host_assign_array()[ids]
@@ -862,7 +889,7 @@ class IVFPQIndex(_IVFBase):
         if self.is_trained:
             state["centroids"] = np.asarray(self.centroids)
             state["codebooks"] = np.asarray(self.codebooks)
-            state["rows"] = self._host_rows_array()
+            state["rows"] = self._rows_in_insertion_order()
             state["assign"] = self._host_assign_array()
             if self.refine_store is not None:
                 state["refine_rows"] = self.refine_store.all_rows()
@@ -883,9 +910,9 @@ class IVFPQIndex(_IVFBase):
         idx.lists = idx._make_lists()
         rows, assign = state["rows"], state["assign"]
         if rows.shape[0]:
-            idx.lists.append(assign, rows, np.arange(rows.shape[0], dtype=np.int64))
-            idx._host_rows = [rows]
-            idx._host_assign = [assign]
+            pos = idx.lists.append(assign, rows, np.arange(rows.shape[0], dtype=np.int64))
+            idx._host_assign = [assign.astype(np.int32)]
+            idx._host_pos = [pos]
             idx._n = rows.shape[0]
         if idx.refine_store is not None and "refine_rows" in state:
             idx.refine_store.add(np.asarray(state["refine_rows"], np.float16))

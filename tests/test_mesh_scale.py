@@ -115,3 +115,83 @@ def test_sharded_kmeans_262144_tier(rng):
     sample = cent[rng.permutation(k)[:4096]]
     dists = np.linalg.norm(sample[:-1] - sample[1:], axis=1)
     assert np.median(dists) > 1e-4  # not collapsed onto one point
+
+
+@pytest.mark.slow
+@pytest.mark.scale
+def test_sharded_kmeans_and_routed_search_1048576_tier(rng):
+    """The 1,048,576-centroid tier (corpora past 1e8 rows, reference
+    index.py:505-508) — the last unexercised tier (VERDICT r4 #4): the
+    random-seed branch at k=1M, one sharded Lloyd psum step against the
+    1M-centroid table, the int32 cell-space guard, and a routed sharded
+    IVF search over the million-list layout.
+
+    A full Lloyd pass at this tier is n*k ~ 1e12 pair-FLOPs — an hour on
+    the 1-core CPU suite — so seeding runs iters=0 (the full pass at 1M is
+    real-TPU bench territory) and the psum step is exercised explicitly on
+    a small row batch against all 1M centroids: the (k, d) sum / (k,)
+    count accumulation shapes and the chunk loop are what this tier
+    changes, and they do not depend on the batch size."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_faiss_tpu.ops.kmeans import auto_chunk
+    from distributed_faiss_tpu.parallel.mesh import (
+        AXIS, ShardedIVFFlatIndex, ShardedPaddedLists, _kmeans_step_jit,
+        make_mesh, sharded_kmeans)
+
+    k = 1_048_576
+    mesh = make_mesh()
+    n, d = k + 4_096, 2
+    x = rng.standard_normal((n, d)).astype(np.float32)
+
+    chunk = auto_chunk(k, None)
+    assert chunk * k * 4 <= 2 ** 31
+
+    # int32 flat-cell-address guard: 1M padded lists at cap 4096 overflows
+    # int32 addressing and must be refused, not silently wrapped
+    with pytest.raises(ValueError, match="int32"):
+        ShardedPaddedLists(k, (d,), np.float32, mesh, min_cap=4096)
+
+    # seeds-from-data branch at k=1M
+    cent = sharded_kmeans(mesh, x, k, iters=0)
+    cent_np = np.asarray(cent)
+    assert cent_np.shape == (k, d)
+    assert np.isfinite(cent_np).all()
+    lo, hi = x.min(0) - 1e-3, x.max(0) + 1e-3
+    assert (cent_np >= lo).all() and (cent_np <= hi).all()
+    sample = cent_np[rng.permutation(k)[:4096]]
+    dists = np.linalg.norm(sample[:-1] - sample[1:], axis=1)
+    assert np.median(dists) > 1e-4  # not collapsed onto one point
+
+    # one sharded Lloyd psum step against the full 1M-centroid table
+    S = mesh.shape[AXIS]
+    nb = chunk * S  # minimal batch that divides per-shard rows by chunk
+    xb = x[:nb]
+    wb = np.ones(nb, np.float32)
+    xs = jax.device_put(jnp.asarray(xb), NamedSharding(mesh, P(AXIS, None)))
+    ws = jax.device_put(jnp.asarray(wb), NamedSharding(mesh, P(AXIS)))
+    stepped = np.asarray(_kmeans_step_jit(xs, ws, cent, mesh, k, chunk))
+    assert stepped.shape == (k, d)
+    assert np.isfinite(stepped).all()
+    # empty centroids keep their seed; touched ones move inside the bbox
+    moved = np.abs(stepped - cent_np).max(1) > 0
+    assert 0 < moved.sum() <= nb
+    assert (stepped >= lo).all() and (stepped <= hi).all()
+
+    # routed search over the million-list layout
+    idx = ShardedIVFFlatIndex(d, k, "l2", mesh=mesh, probe_routing=True)
+    idx.centroids = cent
+    idx.lists = idx._make_lists()
+    idx.add(x[:4096])
+    assert idx.ntotal == 4096
+    idx.set_nprobe(16)
+    q = x[:8] + 1e-3 * rng.standard_normal((8, d)).astype(np.float32)
+    D, I = idx.search(q, 5)
+    assert I.shape == (8, 5)
+    assert (I[:, 0] >= 0).all()
+    # near-duplicate queries: row i's own list is probed with
+    # near-certainty even among a million lists (centroids ARE data rows)
+    hits = sum(i in I[i] for i in range(8))
+    assert hits >= 6, (hits, I)

@@ -163,7 +163,7 @@ def test_routed_matches_masked(rng):
     routed = ShardedIVFFlatIndex(16, 16, "l2", probe_routing=True)
     routed.centroids = masked.centroids
     routed.lists = masked.lists
-    routed._host_rows, routed._host_assign = masked._host_rows, masked._host_assign
+    routed._host_pos, routed._host_assign = masked._host_pos, masked._host_assign
     routed._n = masked._n
     routed.set_nprobe(6)
     Dm, Im = masked.search(q, 10)
@@ -228,7 +228,7 @@ def test_routed_pq_matches_masked(rng, metric):
     routed = ShardedIVFPQIndex(d, 8, m=m, metric=metric, probe_routing=True)
     routed.centroids, routed.codebooks = masked.centroids, masked.codebooks
     routed.lists = masked.lists
-    routed._host_rows, routed._host_assign = masked._host_rows, masked._host_assign
+    routed._host_pos, routed._host_assign = masked._host_pos, masked._host_assign
     routed._n = masked._n
     routed.set_nprobe(5)
     Dm, Im = masked.search(q, 10)
@@ -323,7 +323,7 @@ def test_sharded_pq_refine_lifts_recall(rng, routing):
     from distributed_faiss_tpu.models.ivf import clip_f16
     assign = base_idx._host_assign_array()
     ref.raw_lists.append(assign, clip_f16(x), np.arange(x.shape[0], dtype=np.int64))
-    ref._host_rows, ref._host_assign = base_idx._host_rows, base_idx._host_assign
+    ref._host_pos, ref._host_assign = base_idx._host_pos, base_idx._host_assign
     ref._n = base_idx._n
     ref.set_nprobe(8)
 
@@ -355,7 +355,7 @@ def test_sharded_pq_pallas_matches_xla(rng, routing, refine):
                           refine_k_factor=refine, use_pallas=True)
     b.centroids, b.codebooks = a.centroids, a.codebooks
     b.lists, b.raw_lists = a.lists, a.raw_lists
-    b._host_rows, b._host_assign, b._n = a._host_rows, a._host_assign, a._n
+    b._host_pos, b._host_assign, b._n = a._host_pos, a._host_assign, a._n
     b.set_nprobe(4)
     Da, Ia = a.search(q, 8)
     Db, Ib = b.search(q, 8)
@@ -453,7 +453,7 @@ def test_large_query_batch_sharded_modes(rng):
     routed = ShardedIVFFlatIndex(8, 8, "l2", probe_routing=True)
     routed.centroids = masked.centroids
     routed.lists = masked.lists
-    routed._host_rows, routed._host_assign = masked._host_rows, masked._host_assign
+    routed._host_pos, routed._host_assign = masked._host_pos, masked._host_assign
     routed._n = masked._n
     routed.set_nprobe(3)
     masked.set_nprobe(3)
