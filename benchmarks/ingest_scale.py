@@ -162,7 +162,14 @@ def main():
         # floor is one payload copy (codes/vectors + ids); the
         # no-host-mirror claim is growth ~= that single copy, not 2x.
         rss_per_row = None
-        ingest_t0, ingest_t1 = t0, t0 + secs
+        # anchor the window to the loader's own ingest-start timestamp
+        # (ADVICE r5): t0 is the subprocess spawn time, which includes
+        # python/jax startup and client connect, so a t0-anchored window
+        # shifts earlier than the true ingest interval and absorbs
+        # pre-ingest compile/allocation RSS growth into the per-row number
+        m_ts = re.search(r"ingest start ts=([\d.]+)", log)
+        ingest_t0 = float(m_ts.group(1)) if m_ts else t0
+        ingest_t1 = ingest_t0 + secs
         window = [s for s in sampler.samples
                   if ingest_t0 + 0.5 * secs <= s[0] <= ingest_t1]
         if len(window) >= 2:

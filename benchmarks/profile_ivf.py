@@ -14,10 +14,24 @@ Suspects, measured independently:
                flat in block size, dispatch dominates and bigger blocks are
                near-free QPS).
 
+A/B flags for the stored-norms + pallas flat-scan work (this PR):
+
+  --norms {stored,recompute}   gather the add-time (nlist, cap) fp32 norm
+               sidecar vs recomputing ||x||^2 from the gathered block per
+               query (the pre-change behavior). Bit-exact either way.
+  --kernel {xla,pallas}        the XLA gather+einsum scan vs the fused
+               VMEM pallas kernel (ops/flat_pallas.py). On a non-TPU
+               backend 'pallas' runs the interpreter — correct but slow;
+               use it for numerics, not timing, off-chip.
+
+Run both arms of either flag on the same machine for the A/B line in
+benchmarks/RESULTS.md (BENCH_SMALL=1 for the CPU-sized corpus).
+
 Prints one JSON line per measurement. Safe to run CPU-only (numbers are then
 about the CPU path, labeled by backend).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -39,12 +53,18 @@ def timeit(fn, reps=20, warm=3):
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--norms", choices=("stored", "recompute"), default="stored")
+    ap.add_argument("--kernel", choices=("xla", "pallas"), default="xla")
+    args = ap.parse_args()
+
     import jax
     import jax.numpy as jnp
 
     from distributed_faiss_tpu.models.ivf import IVFFlatIndex, _ivf_flat_search
 
     backend = jax.devices()[0].platform
+    arm = f"{args.norms}/{args.kernel}"
     rng = np.random.default_rng(0)
     small = os.environ.get("BENCH_SMALL") == "1"
     n = 50_000 if small else 500_000
@@ -54,10 +74,12 @@ def main():
     assign = rng.integers(0, nlist, n)
     x = (centers[assign] + rng.standard_normal((n, d))).astype(np.float32)
 
-    idx = IVFFlatIndex(d, nlist, "l2", codec="f16", kmeans_iters=4)
+    idx = IVFFlatIndex(d, nlist, "l2", codec="f16", kmeans_iters=4,
+                       use_pallas=args.kernel == "pallas")
     idx.train(x[: min(n, 100_000)])
     idx.add(x)
     idx.set_nprobe(nprobe)
+    idx.use_stored_norms = args.norms == "stored"
 
     # 1. dispatch floor
     tiny = jnp.zeros((8,), jnp.float32)
@@ -74,7 +96,8 @@ def main():
     print(json.dumps({"case": "transfer_256q", "backend": backend,
                       "ms": round(t * 1e3, 2)}))
 
-    # 3. fused search call at growing block sizes
+    # 3. fused search call at growing block sizes, on the selected A/B arm
+    norms = idx._scan_norms()
     for block in (256, 512, 1024):
         q = (centers[rng.integers(0, nlist, block)]
              + rng.standard_normal((block, d))).astype(np.float32)
@@ -83,19 +106,20 @@ def main():
         def call():
             v, i = _ivf_flat_search(
                 idx.centroids, idx.lists.data, idx.lists.ids, idx.lists.sizes,
-                qj, k, nprobe, 1, "l2", "f16")
+                qj, k, nprobe, 1, "l2", "f16", list_norms=norms,
+                use_pallas=idx.use_pallas)
             np.asarray(v); np.asarray(i)
 
         t = timeit(call, reps=10)
         print(json.dumps({"case": f"search_block{block}", "backend": backend,
-                          "ms": round(t * 1e3, 2),
+                          "arm": arm, "ms": round(t * 1e3, 2),
                           "qps_equiv": round(block / t, 1)}))
 
     # 4. end-to-end idx.search at the bench batch size
     q = (centers[rng.integers(0, nlist, 512)]
          + rng.standard_normal((512, d))).astype(np.float32)
     t = timeit(lambda: idx.search(q, k), reps=10)
-    print(json.dumps({"case": "e2e_512q", "backend": backend,
+    print(json.dumps({"case": "e2e_512q", "backend": backend, "arm": arm,
                       "ms": round(t * 1e3, 2), "qps": round(512 / t, 1)}))
 
 

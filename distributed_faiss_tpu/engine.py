@@ -273,7 +273,18 @@ class Index:
             if cfg.centroids == 0 or cfg.infer_centroids:
                 cfg.centroids = infer_n_centroids(total_data_size)
                 logger.info("inferred cfg.centroids=%d", cfg.centroids)
-        return build_index(cfg)
+        index = build_index(cfg)
+        self._apply_runtime_knobs(index)
+        return index
+
+    def _apply_runtime_knobs(self, index) -> None:
+        """Runtime (non-structural) search knobs from cfg.extra — applied at
+        build/load AND on upd_cfg, so a live shard can be A/B-flipped
+        without retraining. Currently: ``stored_norms`` (IVF-Flat/SQ8 scan;
+        False falls back to recomputing ||x||^2 per query — the bit-exact
+        reference arm, benchmarks/profile_ivf.py --norms)."""
+        if index is not None and hasattr(index, "use_stored_norms"):
+            index.use_stored_norms = bool(self.cfg.extra.get("stored_norms", True))
 
     # ------------------------------------------------------------------ add
 
@@ -426,6 +437,7 @@ class Index:
                 # nprobe doubles as efSearch for graph indexes (reference
                 # _override_nprobe, index.py:487-495)
                 self.tpu_index.set_nprobe(cfg.nprobe)
+                self._apply_runtime_knobs(self.tpu_index)
 
     # ------------------------------------------------------------------ persistence
 

@@ -42,6 +42,26 @@ def _write_rows(data, block, start):
     return jax.lax.dynamic_update_slice(data, block, (start,) + (0,) * (data.ndim - 1))
 
 
+@jax.jit
+def row_norms_f32(rows):
+    """Exact fp32 ``||row||^2`` over the minor axis.
+
+    The ONE norm formula shared by add-time norm storage (models/ivf.py
+    norms sidecar, mesh.py's sharded variant) and every XLA recompute
+    fallback (_ivf_flat_search and the sharded masked/routed scans call
+    this on their decoded blocks): a minor-axis ``jnp.sum(r * r)`` of the
+    fp32-decoded rows, which XLA reduces in the same order regardless of
+    the leading batch shape — so a stored norm is bit-identical to an
+    in-scan recompute and switching between them cannot reorder top-k
+    ties. The one necessary inline copy is the Pallas flat-scan kernel's
+    in-VMEM recompute (ops/flat_pallas.py — a jitted helper can't be
+    called from a kernel body); it states the same formula and is pinned
+    by the same golden-equality tests (tests/test_stored_norms.py).
+    """
+    r = rows.astype(jnp.float32)
+    return jnp.sum(r * r, axis=-1)
+
+
 class DeviceVectorStore:
     """Growable row store in device HBM (rows: vectors or code tuples)."""
 

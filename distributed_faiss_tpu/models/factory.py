@@ -69,9 +69,24 @@ def _build_flat(cfg: IndexCfg):
     return FlatIndex(cfg.dim, cfg.get_metric())
 
 
+def _flat_scan_knobs(cfg: IndexCfg) -> dict:
+    """IVF-Flat/SQ8 scan knobs riding in cfg.extra (engine config plumbing):
+    - pallas_flat: fused VMEM list-scan kernel (ops/flat_pallas.py),
+      oracle-checked on first use with clean XLA fallback;
+    - scan_bf16: bf16 MXU scan, legal only with refine_k_factor > 0 (the
+      constructor enforces it) so the shortlist is rescored exactly;
+    - refine_k_factor: exact fp16 rerank of the top k*factor.
+    """
+    return dict(
+        use_pallas=bool(cfg.extra.get("pallas_flat", False)),
+        scan_bf16=bool(cfg.extra.get("scan_bf16", False)),
+        refine_k_factor=int(cfg.extra.get("refine_k_factor", 0)),
+    )
+
+
 def _build_ivf_simple(cfg: IndexCfg) -> IVFFlatIndex:
     return IVFFlatIndex(cfg.dim, _centroids(cfg), cfg.get_metric(), "f32",
-                        kmeans_iters=_kmeans_iters(cfg))
+                        kmeans_iters=_kmeans_iters(cfg), **_flat_scan_knobs(cfg))
 
 
 def _build_knnlm(cfg: IndexCfg):
@@ -118,7 +133,7 @@ def _build_knnlm(cfg: IndexCfg):
 
 def _build_ivfsq(cfg: IndexCfg) -> IVFFlatIndex:
     return IVFFlatIndex(cfg.dim, _centroids(cfg), cfg.get_metric(), "f16",
-                        kmeans_iters=_kmeans_iters(cfg))
+                        kmeans_iters=_kmeans_iters(cfg), **_flat_scan_knobs(cfg))
 
 
 def _build_hnswsq(cfg: IndexCfg):
@@ -148,7 +163,15 @@ def _build_ivf_tpu(cfg: IndexCfg):
 
     mesh = _mesh(cfg)
     if cfg.extra.get("shard_lists"):
-        # full multi-chip path: inverted lists partitioned across the mesh
+        # full multi-chip path: inverted lists partitioned across the mesh.
+        # The fused flat-scan kernel and bf16 scan are single-chip-only for
+        # now — say so instead of silently serving the masked XLA scan
+        for knob in ("pallas_flat", "scan_bf16", "refine_k_factor"):
+            if cfg.extra.get(knob):
+                logging.getLogger().warning(
+                    "%s is not wired for the sharded (shard_lists=True) "
+                    "flat scan yet; ignored — the masked/routed XLA scan "
+                    "serves this index unrefined", knob)
         return ShardedIVFFlatIndex(cfg.dim, _centroids(cfg), cfg.get_metric(),
                                    mesh=mesh, kmeans_iters=_kmeans_iters(cfg),
                                    probe_routing=bool(cfg.extra.get("probe_routing")))
@@ -157,7 +180,8 @@ def _build_ivf_tpu(cfg: IndexCfg):
             "probe_routing requires shard_lists=True on the ivf_tpu builder; ignored"
         )
     return IvfTpuIndex(cfg.dim, _centroids(cfg), cfg.get_metric(), "f32",
-                       mesh=mesh, kmeans_iters=_kmeans_iters(cfg))
+                       mesh=mesh, kmeans_iters=_kmeans_iters(cfg),
+                       **_flat_scan_knobs(cfg))
 
 
 INDEX_BUILDERS = {
@@ -262,14 +286,24 @@ def parse_factory(cfg: IndexCfg):
         if len(parts) == 2 and parts[0].startswith("IVF"):
             nlist = int(parts[0][3:])
             tail = parts[1]
+            # pallas_flat / scan_bf16 ride cfg.extra (the one extraction in
+            # _flat_scan_knobs); refine comes from the RFlat suffix so the
+            # grammar stays FAISS-shaped
+            knobs = _flat_scan_knobs(cfg)
+            knobs.pop("refine_k_factor")
             if tail == "Flat":
-                return IVFFlatIndex(dim, nlist, metric, "f32", kmeans_iters=iters)
+                return IVFFlatIndex(dim, nlist, metric, "f32", kmeans_iters=iters,
+                                    refine_k_factor=refine_k, **knobs)
             if tail == "SQ8":
                 # RFlat composes: exact fp16 rerank of the sq8 shortlist
                 return IVFFlatIndex(dim, nlist, metric, "sq8", kmeans_iters=iters,
-                                    refine_k_factor=refine_k)
+                                    refine_k_factor=refine_k, **knobs)
             if tail in ("SQfp16", "SQ16"):
-                return IVFFlatIndex(dim, nlist, metric, "f16", kmeans_iters=iters)
+                # RFlat composes under scan_bf16 (the exact rerank is what
+                # makes the bf16 scan legal); without it the constructor
+                # logs and disables refine exactly as before
+                return IVFFlatIndex(dim, nlist, metric, "f16", kmeans_iters=iters,
+                                    refine_k_factor=refine_k, **knobs)
             if tail.startswith("PQ"):
                 return IVFPQIndex(dim, nlist, m=parse_pq_m(tail), metric=metric,
                                   kmeans_iters=iters, refine_k_factor=refine_k)

@@ -8,7 +8,12 @@ TPU-first search path (one jitted program per variant):
   coarse einsum (nq, nlist) -> top-nprobe -> lax.scan over probes, each step
   gathering one (nq, cap, ...) list block from HBM, scoring it on the MXU
   (raw/fp16/sq8 dequant fused into the einsum; PQ via ADC LUT), masking the
-  padded tail, and merging into a running top-k carry.
+  padded tail, and merging into a running top-k carry. The flat/sq8 l2 scan
+  gathers STORED fp32 row norms (a (nlist, cap) sidecar filled at
+  add/encode time, bit-identical to an in-scan recompute) instead of
+  running a second elementwise pass over the block; with use_pallas the
+  whole gather+decode+dot+mask step runs in a fused VMEM kernel
+  (ops/flat_pallas.py) and the fp32 gathered block never exists in HBM.
 
 Coarse assignment follows the reference's quantizer choice (get_quantizer,
 index.py:25-33): argmax inner product for metric=dot, argmin L2 otherwise.
@@ -125,10 +130,24 @@ def _merge_group(carry, s, ids, k):
     return distance.merge_topk(best_v, best_i, cv, cids, k)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "nprobe", "g", "metric", "codec"))
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "g", "metric", "codec",
+                                             "use_pallas", "scan_bf16"))
 def _ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
                      k: int, nprobe: int, g: int, metric: str, codec: str,
-                     vmin=None, span=None):
+                     vmin=None, span=None, list_norms=None,
+                     use_pallas: bool = False, scan_bf16: bool = False):
+    """IVF-Flat/SQ8 probe scan.
+
+    list_norms: (nlist, cap) fp32 stored ``||x||^2`` of the decoded rows
+    (computed once at add/encode time — see base.row_norms_f32); None falls
+    back to recomputing them from the gathered block every query (the
+    pre-stored-norms behavior, kept as the A/B/golden reference).
+    use_pallas: fused VMEM kernel (ops/flat_pallas.py) — the probed tiles
+    stream HBM->VMEM via a scalar-prefetched gather and the fp32
+    ``(nq, g, cap, d)`` block transient never exists.
+    scan_bf16: bf16 MXU scan (halved compute-operand traffic); models gate
+    it behind refine_k_factor > 0 so final scores stay exact.
+    """
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
     _, probes = distance.segmented_argtopk(coarse, nprobe)  # (nq, nprobe)
@@ -143,20 +162,37 @@ def _ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
     )
 
     def body(carry, li):  # li: (nq, g)
-        block = list_data[li].astype(jnp.float32)  # (nq, g, cap, d)
-        if codec == "sq8":
-            block = vmin[None, None, None, :] + block * (span[None, None, None, :] / 255.0)
         ids = list_ids[li]  # (nq, g, cap)
         sizes = list_sizes[li]  # (nq, g)
-        ip = jnp.einsum("qd,qgcd->qgc", q, block, precision=_HIGHEST,
-                        preferred_element_type=jnp.float32)
-        if metric == "dot":
-            s = ip
+        if use_pallas:
+            from distributed_faiss_tpu.ops import flat_pallas
+
+            s = flat_pallas.flat_list_scan_auto(
+                q, list_data, list_ids, li, sizes, list_norms, vmin, span,
+                metric=metric, codec=codec, scan_bf16=scan_bf16,
+            )  # (nq, g, cap), size/ids mask already applied in-kernel
         else:
-            bn = jnp.sum(block * block, axis=3)
-            s = -(qn[:, :, None] - 2.0 * ip + bn)
-        valid = (jnp.arange(cap)[None, None, :] < sizes[:, :, None]) & (ids >= 0)
-        s = jnp.where(valid, s, distance.NEG_INF)
+            block = list_data[li]  # (nq, g, cap, d) storage dtype
+            if codec == "sq8":
+                block = vmin[None, None, None, :] + block.astype(jnp.float32) \
+                    * (span[None, None, None, :] / 255.0)
+            else:
+                block = block.astype(jnp.float32)
+            if scan_bf16:
+                ip = jnp.einsum("qd,qgcd->qgc", q.astype(jnp.bfloat16),
+                                block.astype(jnp.bfloat16),
+                                preferred_element_type=jnp.float32)
+            else:
+                ip = jnp.einsum("qd,qgcd->qgc", q, block, precision=_HIGHEST,
+                                preferred_element_type=jnp.float32)
+            if metric == "dot":
+                s = ip
+            else:
+                bn = (list_norms[li] if list_norms is not None
+                      else base.row_norms_f32(block))
+                s = -(qn[:, :, None] - 2.0 * ip + bn)
+            valid = (jnp.arange(cap)[None, None, :] < sizes[:, :, None]) & (ids >= 0)
+            s = jnp.where(valid, s, distance.NEG_INF)
         return _merge_group(carry, s.reshape(nq, g * cap), ids.reshape(nq, g * cap), k), None
 
     (vals, ids), _ = jax.lax.scan(body, init, groups)
@@ -221,11 +257,13 @@ def _ivf_pq_search(centroids, codebooks, list_codes, list_ids, list_sizes, q,
 
 
 @functools.partial(jax.jit, static_argnames=("k", "scan_k", "nprobe", "g", "metric",
-                                             "codec", "refine"))
+                                             "codec", "refine", "use_pallas",
+                                             "scan_bf16"))
 def _ivf_flat_search_fused(centroids, list_data, list_ids, list_sizes, refine_data,
                            q3, k: int, scan_k: int, nprobe: int, g: int,
                            metric: str, codec: str, refine: bool,
-                           vmin=None, span=None):
+                           vmin=None, span=None, list_norms=None,
+                           use_pallas: bool = False, scan_bf16: bool = False):
     """Whole multi-block search in ONE device launch.
 
     q3: (nblocks, block, d). ``lax.map`` runs the per-block program
@@ -237,7 +275,8 @@ def _ivf_flat_search_fused(centroids, list_data, list_ids, list_sizes, refine_da
     def body(qb):
         vals, ids = _ivf_flat_search(centroids, list_data, list_ids, list_sizes,
                                      qb, scan_k, nprobe, g, metric, codec,
-                                     vmin, span)
+                                     vmin, span, list_norms,
+                                     use_pallas=use_pallas, scan_bf16=scan_bf16)
         if refine:
             vals, ids = _rerank_exact(refine_data, qb, ids, k, metric)
         return vals, ids
@@ -330,7 +369,7 @@ class _IVFBase(base.TpuIndex):
         rows = self._encode(x, assign)
         gids = np.arange(self._n, self._n + x.shape[0], dtype=np.int64)
         pos = self.lists.append(assign, rows, gids)
-        self._append_extra(x, assign, gids)
+        self._append_extra(x, assign, gids, rows)
         self._host_assign.append(assign.astype(np.int32))
         self._host_pos.append(pos)
         self._n += x.shape[0]
@@ -353,15 +392,19 @@ class _IVFBase(base.TpuIndex):
             self.lists, self._host_assign_array()[ids], self._host_pos_array()[ids]
         )
 
-    def _rows_in_insertion_order(self, chunk: int = 1 << 20) -> np.ndarray:
+    def _rows_in_insertion_order(self, chunk: int = 1 << 20, lists=None) -> np.ndarray:
         """Stream the full encoded payload back from device in id order
         (persistence). Host cost is the output array itself — the same bytes
-        the save file needs — plus one chunk of gather transients."""
-        out = np.zeros((self._n,) + tuple(self.lists.payload_shape),
-                       self.lists.dtype)
+        the save file needs — plus one chunk of gather transients. ``lists``
+        selects a sidecar sharing the payload lists' (assign, pos) layout
+        (e.g. the stored-norms lists); default is the payload lists."""
+        lists = lists if lists is not None else self.lists
+        out = np.zeros((self._n,) + tuple(lists.payload_shape), lists.dtype)
+        assign, pos = self._host_assign_array(), self._host_pos_array()
         for s in range(0, self._n, chunk):
             e = min(self._n, s + chunk)
-            out[s:e] = self._device_rows(np.arange(s, e, dtype=np.int64))
+            ids = np.arange(s, e, dtype=np.int64)
+            out[s:e] = base.gather_list_rows(lists, assign[ids], pos[ids])
         return out
 
     def _search_blocks(self, q: np.ndarray, k: int, fn, block: int = 256,
@@ -415,8 +458,13 @@ class _IVFBase(base.TpuIndex):
     def _encode(self, x: np.ndarray, assign: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
-    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray) -> None:
-        """Hook: store side-car payloads (e.g. raw rows for exact refine)."""
+    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray,
+                      rows: np.ndarray) -> None:
+        """Hook: store side-car payloads (raw rows for exact refine, stored
+        row norms for the flat scan). ``rows`` is the encoded payload the
+        lists just stored — norms must be computed from the DECODED stored
+        value, not the fp32 input, to stay bit-identical to an in-scan
+        recompute."""
 
 
 def clip_f16(x: np.ndarray) -> np.ndarray:
@@ -437,27 +485,54 @@ class IVFFlatIndex(_IVFBase):
     _DTYPES = {"f32": np.float32, "f16": np.float16, "sq8": np.uint8}
 
     def __init__(self, dim: int, nlist: int, metric: str = "l2", codec: str = "f32",
-                 kmeans_iters: int = 10, refine_k_factor: int = 0):
+                 kmeans_iters: int = 10, refine_k_factor: int = 0,
+                 use_pallas: bool = False, scan_bf16: bool = False):
         super().__init__(dim, nlist, metric, kmeans_iters)
         if codec not in self._DTYPES:
             raise ValueError(f"unknown ivf_flat codec {codec!r}")
         self.codec = codec
         self.sq_params = None
         # exact fp16 rerank of the top k*refine_k_factor (factory "RFlat"
-        # suffix). Only meaningful for the sq8 codec: the f16 list codec
-        # already matches the refine store's precision and f32 is exact
-        if refine_k_factor and codec != "sq8":
+        # suffix). Meaningful for the sq8 codec (codec noise) and for any
+        # codec under scan_bf16 (bf16 matmul noise); otherwise the f16 list
+        # codec already matches the refine store's precision and f32 is exact
+        if refine_k_factor and codec != "sq8" and not scan_bf16:
             logging.getLogger().warning(
                 "refine_k_factor on the %s codec adds no precision over the "
                 "stored lists; disabled", codec
             )
             refine_k_factor = 0
+        if scan_bf16 and not refine_k_factor:
+            raise ValueError(
+                "scan_bf16 perturbs scan scores (bf16 MXU pass) and is only "
+                "legal with refine_k_factor > 0 so the shortlist is rescored "
+                "exactly (the lut_bf16 precedent, ops/adc_pallas.py)"
+            )
         self.refine_k_factor = int(refine_k_factor)
         self.refine_store = (
             base.DeviceVectorStore((dim,), jnp.float16) if self.refine_k_factor else None
         )
+        # fused VMEM list-scan kernel (ops/flat_pallas.py); guarded like the
+        # ADC kernel — oracle-checked on first use, runtime demotion to the
+        # XLA path on kernel fault (never persisted)
+        self.use_pallas = bool(use_pallas)
+        self.scan_bf16 = bool(scan_bf16)
+        self._pallas_runtime_ok = True
+        self._pallas_flat_validated = False
+        # stored-norms scan is the default; the recompute path stays as the
+        # bit-exact golden reference and the profile_ivf A/B arm
+        self.use_stored_norms = True
+        self.norm_lists = None  # (nlist, cap) fp32 sidecar, layout == lists
 
     def _make_lists(self):
+        # exact fp32 ||x||^2 per stored row, appended in lockstep with the
+        # payload (same assign/gids stream -> same (slot, pos) layout and
+        # capacity growth), so the scan gathers (nq, g, cap) norms instead
+        # of re-deriving them from the block every query. Only l2 ever
+        # reads norms — a dot index skips the sidecar entirely (no extra
+        # HBM, no per-add launch, no snapshot payload).
+        if self.metric == "l2":
+            self.norm_lists = base.PaddedLists(self.nlist, (), np.float32)
         return base.PaddedLists(self.nlist, (self.dim,), self._DTYPES[self.codec])
 
     def train(self, x: np.ndarray) -> None:
@@ -472,38 +547,115 @@ class IVFFlatIndex(_IVFBase):
             return np.asarray(sq.sq8_encode(x, self.sq_params["vmin"], self.sq_params["span"]))
         return x.astype(self._DTYPES[self.codec])
 
-    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray) -> None:
+    def _row_norms(self, rows: np.ndarray, chunk: int = 1 << 20) -> np.ndarray:
+        """Exact fp32 ||x||^2 of ENCODED rows after decode — the same decode
+        + minor-axis fp32 sum the scan's recompute path runs, so stored and
+        recomputed norms are bit-identical (golden-equality tests). Chunked:
+        the snapshot-backfill caller hands the whole corpus at once, and an
+        unchunked decode would materialize an (n, d) fp32 transient (~300 GB
+        at the 1e8 x 768 rehearsal scale)."""
+        out = np.empty(rows.shape[0], np.float32)
+        for s in range(0, rows.shape[0], chunk):
+            r = jnp.asarray(rows[s:s + chunk])
+            if self.codec == "sq8":
+                r = sq.sq8_decode(r, self.sq_params["vmin"], self.sq_params["span"])
+            out[s:s + chunk] = np.asarray(base.row_norms_f32(r))
+        return out
+
+    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray,
+                      rows: np.ndarray) -> None:
         if self.refine_store is not None:
             self.refine_store.add(clip_f16(x))
+        if self.norm_lists is not None:
+            self.norm_lists.append(assign, self._row_norms(rows), gids)
+
+    def _scan_norms(self):
+        if not (self.use_stored_norms and self.norm_lists is not None):
+            return None
+        assert self.norm_lists.cap == self.lists.cap, \
+            "norm/payload list capacities diverged"
+        return self.norm_lists.data
+
+    def _validate_flat_pallas(self, scan) -> None:
+        """First-use oracle check (mirrors the adc_pallas discipline): run
+        the pallas kernel and the XLA path on one tiny padded block and
+        demote the kernel for this process if they disagree. A probe where
+        BOTH paths fail is a bad request — leave the kernel alone and let
+        the real search surface the error through pallas_guarded."""
+        self._pallas_flat_validated = True
+        try:
+            pv, _ = scan(self._pallas_probe, True)
+            jax.block_until_ready(pv)
+        except Exception:
+            try:
+                jax.block_until_ready(scan(self._pallas_probe, False))
+            except Exception:
+                return  # both failed: request/state problem, not the kernel
+            self._pallas_runtime_ok = False
+            logger.exception(
+                "pallas flat-scan kernel failed its first-use oracle check; "
+                "using the XLA scan for the rest of this process"
+            )
+            return
+        xv, _ = scan(self._pallas_probe, False)
+        pv, xv = np.asarray(pv), np.asarray(xv)
+        finite = np.isfinite(xv)
+        if not (np.array_equal(finite, np.isfinite(pv))
+                and np.allclose(pv[finite], xv[finite], rtol=1e-3, atol=1e-3)):
+            self._pallas_runtime_ok = False
+            logger.error(
+                "pallas flat-scan kernel disagrees with the XLA oracle on "
+                "first use (max delta %.3g); using the XLA scan",
+                float(np.max(np.abs(pv[finite] - xv[finite]))) if finite.any() else 0.0,
+            )
 
     def search(self, q: np.ndarray, k: int):
         if self._n == 0:
             return self._empty_results(q.shape[0], k)
         nprobe = min(self.nprobe, self.nlist)
         # group payload: the gathered fp32 (nb, g, cap, d) block; nb chosen
-        # launch-bound-aware (see base.pick_query_block)
+        # launch-bound-aware (see base.pick_query_block). The pallas kernel
+        # never materializes that block, but sizing for the XLA fallback
+        # keeps the budgets valid on whichever path actually runs.
         nb = base.pick_query_block(self.lists.cap * self.dim * 4)
         g = probe_group_size(nprobe, nb * self.lists.cap * self.dim * 4)
         extra = {}
         if self.codec == "sq8":
             extra = dict(vmin=self.sq_params["vmin"], span=self.sq_params["span"])
+        norms = self._scan_norms()
         scan_k = k * self.refine_k_factor if self.refine_k_factor else k
 
-        def run(b):
-            vals, ids = _ivf_flat_search(
+        def scan(b, with_pallas):
+            return _ivf_flat_search(
                 self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
-                b, scan_k, nprobe, g, self.metric, self.codec, **extra,
+                b, scan_k, nprobe, g, self.metric, self.codec,
+                list_norms=norms, use_pallas=with_pallas,
+                scan_bf16=self.scan_bf16, **extra,
             )
+
+        if self.use_pallas and self._pallas_runtime_ok and not self._pallas_flat_validated:
+            self._pallas_probe = jnp.asarray(
+                distance.pad_rows(np.asarray(q[:8], np.float32), 8))
+            self._validate_flat_pallas(scan)
+
+        def run(b):
+            vals, ids = pallas_guarded(
+                self, lambda p: scan(b, p), 0, 0, shape=tuple(b.shape))
             if self.refine_k_factor:
                 vals, ids = _rerank_exact(self.refine_store.data, b, ids, k, self.metric)
             return vals, ids
 
         def run_fused(q3):
-            return _ivf_flat_search_fused(
-                self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
-                self.refine_store.data if self.refine_k_factor else None,
-                q3, k, scan_k, nprobe, g, self.metric, self.codec,
-                bool(self.refine_k_factor), **extra,
+            return pallas_guarded(
+                self,
+                lambda p: _ivf_flat_search_fused(
+                    self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
+                    self.refine_store.data if self.refine_k_factor else None,
+                    q3, k, scan_k, nprobe, g, self.metric, self.codec,
+                    bool(self.refine_k_factor), list_norms=norms,
+                    use_pallas=p, scan_bf16=self.scan_bf16, **extra,
+                ),
+                0, 0, shape=tuple(q3.shape),
             )
 
         return self._search_blocks(q, k, run, block=nb, fused_fn=run_fused)
@@ -524,11 +676,16 @@ class IVFFlatIndex(_IVFBase):
             "nprobe": self.nprobe,
             "trained": self.is_trained,
             "refine_k_factor": self.refine_k_factor,
+            "use_pallas": self.use_pallas,
+            "scan_bf16": self.scan_bf16,
         }
         if self.is_trained:
             state["centroids"] = np.asarray(self.centroids)
             state["rows"] = self._rows_in_insertion_order()
             state["assign"] = self._host_assign_array()
+            if self._n and self.norm_lists is not None:
+                state["list_norms"] = self._rows_in_insertion_order(
+                    lists=self.norm_lists)
             if self.sq_params is not None:
                 state["sq_vmin"] = np.asarray(self.sq_params["vmin"])
                 state["sq_span"] = np.asarray(self.sq_params["span"])
@@ -536,23 +693,42 @@ class IVFFlatIndex(_IVFBase):
                 state["refine_rows"] = self.refine_store.all_rows()
         return state
 
+    def _restore_norms(self, state, rows, assign, gids) -> None:
+        """Append the norms sidecar on load: from the snapshot when present,
+        else backfilled from the decoded rows (pre-norms snapshots) — the
+        two are bit-identical by construction (_row_norms)."""
+        if self.norm_lists is None:  # dot metric: no sidecar to restore
+            return
+        if "list_norms" in state:
+            norms = np.asarray(state["list_norms"], np.float32)
+        else:
+            logger.info(
+                "snapshot predates stored norms: backfilling %d row norms "
+                "from the decoded payload", rows.shape[0])
+            norms = self._row_norms(rows)
+        self.norm_lists.append(assign, norms, gids)
+
     @classmethod
     def from_state_dict(cls, state) -> "IVFFlatIndex":
         idx = cls(int(state["dim"]), int(state["nlist"]), str(state["metric"]), str(state["codec"]),
-                  refine_k_factor=int(state.get("refine_k_factor", 0)))
+                  refine_k_factor=int(state.get("refine_k_factor", 0)),
+                  use_pallas=bool(state.get("use_pallas", False)),
+                  scan_bf16=bool(state.get("scan_bf16", False)))
         idx.nprobe = int(state["nprobe"])
         if not bool(state["trained"]):
             return idx
         idx.centroids = jnp.asarray(state["centroids"])
         if "sq_vmin" in state:
             idx.sq_params = {"vmin": jnp.asarray(state["sq_vmin"]), "span": jnp.asarray(state["sq_span"])}
-        idx.lists = base.PaddedLists(idx.nlist, (idx.dim,), cls._DTYPES[idx.codec])
+        idx.lists = idx._make_lists()
         rows, assign = state["rows"], state["assign"]
         if rows.shape[0]:
-            pos = idx.lists.append(assign, rows, np.arange(rows.shape[0], dtype=np.int64))
+            gids = np.arange(rows.shape[0], dtype=np.int64)
+            pos = idx.lists.append(assign, rows, gids)
             idx._host_assign = [assign.astype(np.int32)]
             idx._host_pos = [pos]
             idx._n = rows.shape[0]
+            idx._restore_norms(state, rows, assign, gids)
             if idx.refine_store is not None:
                 idx.refine_store.add(np.asarray(state["refine_rows"], np.float16))
         return idx
@@ -610,17 +786,21 @@ def _same_failure(a: Exception, b: Exception) -> bool:
 # repeat of a seen signature demotes the nibble kernel (a broken kernel
 # fails identically every time, and a set survives unrelated bad requests
 # interleaving with it); distinct signatures never accumulate toward a
-# demotion. Known tradeoff: two same-kind bad requests differing only in
-# numerics (masked by _norm_msg) share a signature and spuriously demote —
-# bounded cost (one sweep, monotone) accepted to keep a broken kernel whose
-# oracle failure mirrors it from re-faulting forever. Capped: a process
-# accumulating 16 distinct both-failed signatures with nibble on is
-# systematically unhealthy — treat overflow as a repeat.
+# demotion. The signature includes the request's query/batch shape (ADVICE
+# r5): _norm_msg masks every digit run, so two bad requests differing only
+# in numerics used to normalize equal and spuriously demote — a broken
+# kernel repeats on the SAME compiled shape, while distinct-shape bad
+# requests are now distinct signatures. The residual tradeoff (a client
+# retrying one malformed request demotes) is bounded cost (one sweep,
+# monotone), accepted to keep a broken kernel whose oracle failure mirrors
+# it from re-faulting forever. Capped: a process accumulating 16 distinct
+# both-failed signatures with nibble on is systematically unhealthy —
+# treat overflow as a repeat.
 _BOTH_FAILED_SIGS = set()
 _BOTH_FAILED_CAP = 16
 
 
-def pallas_guarded(index, call, m: int, ksub: int):
+def pallas_guarded(index, call, m: int, ksub: int, shape=None):
     """Run ``call(use_pallas)`` with kernel-fault attribution (ADVICE r3: a
     nibble failure must not abandon the proven one-hot kernel).
 
@@ -647,7 +827,12 @@ def pallas_guarded(index, call, m: int, ksub: int):
     hand, with no synchronous re-trace inside any request.
     ``index`` provides use_pallas/_pallas_runtime_ok; every attempt runs
     under ``jax.block_until_ready`` so asynchronous kernel aborts surface
-    here, not at a later np.asarray.
+    here, not at a later np.asarray. ``shape`` is the request's query/batch
+    shape, folded into the both-failed signature (see _BOTH_FAILED_SIGS).
+
+    The flat-scan kernel (ops/flat_pallas.py) reuses this guard with
+    m=ksub=0: nibble_supported is then False, which reduces the ladder to
+    exactly "pallas kernel -> XLA oracle -> demote _pallas_runtime_ok".
     """
     with_pallas = index.use_pallas and index._pallas_runtime_ok
     nibble_was_on = _adc_pallas.USE_NIBBLE
@@ -685,7 +870,7 @@ def pallas_guarded(index, call, m: int, ksub: int):
             # _BOTH_FAILED_SIGS) costs one cache sweep per process,
             # bounded by the monotone flag.
             if nibble_eligible and nibble_was_on:
-                sig = (type(kernel_err).__name__, _norm_msg(kernel_err))
+                sig = (type(kernel_err).__name__, _norm_msg(kernel_err), shape)
                 with _adc_pallas.NIBBLE_LOCK:
                     repeat = (sig in _BOTH_FAILED_SIGS
                               or len(_BOTH_FAILED_SIGS) >= _BOTH_FAILED_CAP)
@@ -746,9 +931,9 @@ def pallas_guarded(index, call, m: int, ksub: int):
                 )
                 return out
         logger.exception(
-            "pallas ADC (one-hot) kernel failed on this backend; using "
-            "the XLA path for the rest of this process (persisted "
-            "use_pallas intent is unchanged)"
+            "pallas kernel (%s) failed on this backend; using the XLA path "
+            "for the rest of this process (persisted use_pallas intent is "
+            "unchanged)", "ADC one-hot" if ksub else "flat scan",
         )
         index._pallas_runtime_ok = False
         return out
@@ -813,7 +998,8 @@ class IVFPQIndex(_IVFBase):
             x = x - np.asarray(self.centroids)[assign]
         return np.asarray(pq.pq_encode(jnp.asarray(x), self.codebooks))
 
-    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray) -> None:
+    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray,
+                      rows: np.ndarray) -> None:
         if self.refine_store is not None:
             self.refine_store.add(clip_f16(x))
 
@@ -839,6 +1025,7 @@ class IVFPQIndex(_IVFBase):
         def run(b):
             vals, ids = pallas_guarded(
                 self, lambda p: adc(b, p), self.m, self.codebooks.shape[1],
+                shape=tuple(b.shape),
             )
             if self.refine_k_factor:
                 vals, ids = _rerank_exact(self.refine_store.data, b, ids, k, self.metric)
@@ -859,6 +1046,7 @@ class IVFPQIndex(_IVFBase):
             # same degrade ladder as the per-block path
             return pallas_guarded(
                 self, lambda p: adc_fused(q3, p), self.m, self.codebooks.shape[1],
+                shape=tuple(q3.shape),
             )
 
         return self._search_blocks(q, k, run, block=nb, fused_fn=run_fused)

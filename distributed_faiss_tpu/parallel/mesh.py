@@ -46,7 +46,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 try:  # jax >= 0.6 exposes shard_map at the top level
     from jax import shard_map as _shard_map_fn
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map_fn
+    import inspect as _inspect
+
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    if "check_vma" in _inspect.signature(_shard_map_impl).parameters:
+        _shard_map_fn = _shard_map_impl
+    else:
+        # older jax spells the replication-check knob ``check_rep``; the
+        # semantics of check_vma=False (skip the static replication/varying
+        # inference this module's integer id paths defeat) carry over 1:1
+        def _shard_map_fn(f, *, mesh, in_specs, out_specs, check_vma=None):
+            kw = {} if check_vma is None else {"check_rep": check_vma}
+            return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, **kw)
 
 from distributed_faiss_tpu.models import base
 from distributed_faiss_tpu.models import ivf as ivfmod
@@ -523,7 +536,8 @@ class ShardedPaddedLists:
 
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric"))
 def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
-                             mesh, k: int, nprobe: int, g: int, metric: str):
+                             mesh, k: int, nprobe: int, g: int, metric: str,
+                             list_norms=None):
     """Corpus lists sharded across the mesh; probes masked by ownership.
 
     Every chip runs the same probe-group gathers against its local list
@@ -531,6 +545,12 @@ def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
     candidates ride one all_gather. Honest trade-off (documented): each chip
     does the full gather-shape work, so this scales HBM capacity with chips,
     not FLOPs — probe bucketing/routing is the next step.
+
+    list_norms: mesh-sharded (nlist_pad, cap) fp32 stored ``||x||^2``
+    sidecar (same layout as list_data) — gathered per probe instead of
+    recomputed from the block, exactly like the single-chip scan in
+    models/ivf.py so the two implementations can't drift; None keeps the
+    recompute path (golden/A-B reference).
     """
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
@@ -541,7 +561,7 @@ def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
     S = mesh.shape[AXIS]
     groups = probes.reshape(nq, nprobe // g, g).transpose(1, 0, 2)
 
-    def local(q, qn, groups, data_local, ids_local, sizes_local):
+    def local(q, qn, groups, data_local, ids_local, sizes_local, norms_local):
         ax = jax.lax.axis_index(AXIS).astype(jnp.int32)
         # never-taken select: structural data dependency on the sharded input
         # so the scan carry's device-varying annotation matches the body
@@ -564,7 +584,8 @@ def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
             if metric == "dot":
                 s = ip
             else:
-                bn = jnp.sum(block * block, axis=3)
+                bn = (norms_local[slot] if norms_local is not None
+                      else base.row_norms_f32(block))
                 s = -(qn[:, :, None] - 2.0 * ip + bn)
             valid = (jnp.arange(cap)[None, None, :] < sizes[:, :, None])
             valid = valid & (ids >= 0) & mine[:, :, None]
@@ -583,8 +604,18 @@ def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
         best, pos = jax.lax.top_k(fv, k)
         return best, jnp.take_along_axis(fi, pos, axis=1)
 
+    if list_norms is not None:
+        fn = _shard_map_fn(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS),
+                      P(AXIS, None)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return fn(q, qn, groups, list_data, list_ids, list_sizes, list_norms)
     fn = _shard_map_fn(
-        local,
+        lambda a, b, c, d, e, f: local(a, b, c, d, e, f, None),
         mesh=mesh,
         in_specs=(P(), P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS)),
         out_specs=(P(), P()),
@@ -613,12 +644,19 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
         self.centroids = sharded_kmeans(self.mesh, x, self.nlist, iters=self.kmeans_iters)
 
     def _make_lists(self):
+        # stored-norms sidecar, sharded with the same strided ownership as
+        # the payload lists so one (slot, pos) addresses both (the raw_lists
+        # precedent in ShardedIVFPQIndex); dot never reads norms (see the
+        # single-chip _make_lists)
+        if self.metric == "l2":
+            self.norm_lists = ShardedPaddedLists(self.nlist, (), np.float32, self.mesh)
         return ShardedPaddedLists(self.nlist, (self.dim,), np.float32, self.mesh)
 
     def search(self, q: np.ndarray, k: int):
         if self._n == 0:
             return self._empty_results(q.shape[0], k)
         nprobe = min(self.nprobe, self.nlist)
+        norms = self._scan_norms()
         if self.probe_routing:
             # pair group sized so the (group, cap, d) fp32 block stays <=64MB
             group = max(8, min(1024, (64 << 20) // max(1, self.lists.cap * self.dim * 4)))
@@ -627,7 +665,7 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
                 lambda block, n, bucket: _sharded_ivf_flat_search_routed(
                     self.centroids, self.lists.data, self.lists.ids,
                     self.lists.sizes, block, n, self.mesh, k, nprobe, bucket,
-                    group, self.metric,
+                    group, self.metric, list_norms=norms,
                 ),
             )
         nb = base.pick_query_block(self.lists.cap * self.dim * 4)
@@ -636,12 +674,12 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
             q, k,
             lambda b: _sharded_ivf_flat_search(
                 self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
-                b, self.mesh, k, nprobe, gsz, self.metric,
+                b, self.mesh, k, nprobe, gsz, self.metric, list_norms=norms,
             ),
             block=nb,
             fused_fn=lambda q3: _sharded_ivf_flat_search_fused(
                 self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
-                q3, self.mesh, k, nprobe, gsz, self.metric,
+                q3, self.mesh, k, nprobe, gsz, self.metric, list_norms=norms,
             ),
         )
 
@@ -659,19 +697,23 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
         if not bool(state["trained"]):
             return idx
         idx.centroids = jnp.asarray(state["centroids"])
-        idx.lists = ShardedPaddedLists(idx.nlist, (idx.dim,), np.float32, idx.mesh)
+        idx.lists = idx._make_lists()
         rows, assign = state["rows"], state["assign"]
         if rows.shape[0]:
-            pos = idx.lists.append(assign, rows, np.arange(rows.shape[0], dtype=np.int64))
+            gids = np.arange(rows.shape[0], dtype=np.int64)
+            pos = idx.lists.append(assign, rows, gids)
             idx._host_assign = [assign.astype(np.int32)]
             idx._host_pos = [pos]
             idx._n = rows.shape[0]
+            # snapshot norms when present, backfill pre-norms snapshots
+            idx._restore_norms(state, rows, assign, gids)
         return idx
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric"))
 def _sharded_ivf_flat_search_fused(centroids, list_data, list_ids, list_sizes, q3,
-                                   mesh, k: int, nprobe: int, g: int, metric: str):
+                                   mesh, k: int, nprobe: int, g: int, metric: str,
+                                   list_norms=None):
     """Multi-block sharded search in one launch: lax.map over stacked query
     blocks, shard_map per block inside (launch-bound serving — see
     models.base.pick_query_block)."""
@@ -679,7 +721,7 @@ def _sharded_ivf_flat_search_fused(centroids, list_data, list_ids, list_sizes, q
     def body(qb):
         return _sharded_ivf_flat_search(centroids, list_data, list_ids,
                                         list_sizes, qb, mesh, k, nprobe, g,
-                                        metric)
+                                        metric, list_norms=list_norms)
 
     return jax.lax.map(body, q3)
 
@@ -860,7 +902,8 @@ class ShardedIVFPQIndex(IVFPQIndex):
             )
         return ShardedPaddedLists(self.nlist, (self.m,), np.uint8, self.mesh)
 
-    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray):
+    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray,
+                      rows: np.ndarray):
         if self.raw_lists is not None:
             from distributed_faiss_tpu.models.ivf import clip_f16
 
@@ -909,9 +952,12 @@ class ShardedIVFPQIndex(IVFPQIndex):
 
         def guarded(call, *args):
             # same degrade ladder as the unsharded path: nibble pallas ->
-            # one-hot pallas -> XLA, one rung per proven failure
+            # one-hot pallas -> XLA, one rung per proven failure; the first
+            # arg is always the query block/stack, whose shape keys the
+            # both-failed signature (ADVICE r5)
             return ivfmod.pallas_guarded(
                 self, lambda p: call(*args, p), self.m, self.codebooks.shape[1],
+                shape=tuple(args[0].shape),
             )
 
         if self.probe_routing:
@@ -1118,12 +1164,15 @@ def _routed_pairs_local(probes, nq_real, nprobe: int, pair_bucket: int,
                                              "group", "metric"))
 def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, q,
                                     nq_real, mesh, k: int, nprobe: int,
-                                    pair_bucket: int, group: int, metric: str):
+                                    pair_bucket: int, group: int, metric: str,
+                                    list_norms=None):
     """Probe-routed sharded IVF: FLOPs scale with the mesh, not just capacity.
 
     The masked variant (_sharded_ivf_flat_search) has every chip do the full
     (nq x nprobe) gather/einsum work and zero out non-owned probes. Here each
     chip scores only the pairs it owns (see _routed_pairs_local).
+    list_norms: sharded stored-norms sidecar (see _sharded_ivf_flat_search);
+    None recomputes from the block.
 
     pair_bucket bounds per-chip work; pairs beyond it are DROPPED (skewed
     ownership). The third return value is the max dropped-pairs count across
@@ -1139,7 +1188,8 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
     S = mesh.shape[AXIS]
     qn = jnp.sum(q * q, axis=1, keepdims=True)
 
-    def local(q, qn, probes, nq_real, data_local, ids_local, sizes_local):
+    def local(q, qn, probes, nq_real, data_local, ids_local, sizes_local,
+              norms_local):
         anchor = jnp.where(jnp.zeros((), bool),
                            data_local.reshape(-1)[0].astype(jnp.float32), 0.0)
 
@@ -1153,7 +1203,8 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
             if metric == "dot":
                 s = ip
             else:
-                bn = jnp.sum(block * block, axis=2)
+                bn = (norms_local[slot] if norms_local is not None
+                      else base.row_norms_f32(block))
                 s = -(qn[qi] - 2.0 * ip + bn)
             ok = (jnp.arange(cap)[None, :] < sizes[:, None]) & (ids >= 0)
             ok = ok & valid[:, None]
@@ -1162,8 +1213,19 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
         return _routed_pairs_local(probes, nq_real, nprobe, pair_bucket, group,
                                    k, cap, S, anchor, score_group)
 
+    if list_norms is not None:
+        fn = _shard_map_fn(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(AXIS, None, None), P(AXIS, None),
+                      P(AXIS), P(AXIS, None)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+        return fn(q, qn, probes, jnp.asarray(nq_real, jnp.int32),
+                  list_data, list_ids, list_sizes, list_norms)
     fn = _shard_map_fn(
-        local,
+        lambda a, b, c, d, e, f, g_: local(a, b, c, d, e, f, g_, None),
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS)),
         out_specs=(P(), P(), P()),

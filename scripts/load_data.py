@@ -76,6 +76,11 @@ def main():
     save_every = args.save_every_rows * num_servers
 
     t0 = time.time()
+    # machine-readable anchor for drivers that window measurements to the
+    # actual ingest interval (benchmarks/ingest_scale.py parses this —
+    # anchoring to the driver's subprocess-spawn time would fold python/jax
+    # startup and client connect into the window)
+    logger.info("ingest start ts=%.3f", t0)
     since_save = 0
     for s in range(0, rows, args.bs):
         batch = np.asarray(data[s:s + args.bs], np.float32)

@@ -82,6 +82,7 @@ def test_fused_matches_per_block_loop(rng, small_blocks):
             idx.centroids, idx.lists.data, idx.lists.ids, idx.lists.sizes,
             b, scan_k, nprobe, g, "l2", "sq8",
             vmin=idx.sq_params["vmin"], span=idx.sq_params["span"],
+            list_norms=idx._scan_norms(),
         )
         return ivfmod._rerank_exact(idx.refine_store.data, b, ids, k, "l2")
 
