@@ -326,9 +326,10 @@ class Index:
                     logger.info("add worker: index dropped mid-add, exiting")
                     return
                 self.tpu_index.add(add_data)
+                ntotal = self.tpu_index.ntotal
             logger.info(
                 "added %d vectors in %.3fs (ntotal=%d)",
-                add_data.shape[0], time.time() - start_time, self.tpu_index.ntotal,
+                add_data.shape[0], time.time() - start_time, ntotal,
             )
             self._maybe_save(ignore_time=False)
 
@@ -388,11 +389,14 @@ class Index:
         with self.buffer_lock:
             meta_arr, meta_n = self.id_to_metadata.snapshot()
         valid = indexes != -1
-        if valid.any() and int(indexes.max()) >= meta_n:
+        # single host-side pass (invalid slots are -1, always < meta_n, so
+        # the max doubles as the valid-id check)
+        max_id = np.max(indexes, initial=-1)
+        if max_id >= meta_n:
             # loud failure on index/metadata desync (e.g. a concurrent
             # drop_index mid-search) — never serve clipped/stale metadata
             raise IndexError(
-                f"search returned id {int(indexes.max())} >= metadata size {meta_n}"
+                f"search returned id {max_id} >= metadata size {meta_n}"
             )
         safe = np.where(valid, indexes, 0)
         joined = meta_arr.take(safe.ravel()).reshape(indexes.shape)

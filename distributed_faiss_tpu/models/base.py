@@ -109,6 +109,7 @@ class DeviceVectorStore:
         """Fetch rows by id (host round-trip)."""
         if self.data is None:
             return np.zeros((0,) + self.row_shape, self.dtype)
+        # graftlint: ok(host-sync): "host round-trip" is this method's contract
         return np.asarray(self.data[jnp.asarray(ids, jnp.int32)])
 
     def all_rows(self) -> np.ndarray:
@@ -148,6 +149,7 @@ def gather_list_rows(lists, assign, pos, bucket_min: int = 1024) -> np.ndarray:
     bucket = _next_pow2(n, bucket_min)
     fidx = np.zeros(bucket, np.int64)
     fidx[:n] = flat
+    # graftlint: ok(host-sync): reconstruct/persistence host fetch by design
     out = np.asarray(_gather_flat_rows(lists.data, jnp.asarray(fidx)))
     return out[:n]
 

@@ -23,6 +23,7 @@ import numpy as np
 
 from distributed_faiss_tpu.models import base
 from distributed_faiss_tpu.ops import distance, sq
+from distributed_faiss_tpu.utils import sanitize
 
 _CODEC_DTYPES = {
     "f32": jnp.float32,
@@ -99,10 +100,12 @@ class FlatIndex(base.TpuIndex):
             # fused variants, not one per distinct batch size
             nblocks = base._next_pow2(-(-nq // nb), 1)
             qp = np.pad(q, ((0, nblocks * nb - nq), (0, 0)))
-            vals, ids = _flat_search_fused(
+            vals, ids = sanitize.maybe_checked(
+                _flat_search_fused,
                 jnp.asarray(qp.reshape(nblocks, nb, -1)), self.store.data,
-                jnp.asarray(self.store.ntotal, jnp.int32), k, self.metric,
-                self.codec, vmin=kwargs.get("vmin"), span=kwargs.get("span"),
+                jnp.asarray(self.store.ntotal, jnp.int32), k=k,
+                metric=self.metric, codec=self.codec,
+                vmin=kwargs.get("vmin"), span=kwargs.get("span"),
             )
             out_s = np.asarray(vals).reshape(nblocks * nb, -1)[:nq]
             out_i = np.asarray(ids).reshape(nblocks * nb, -1)[:nq].astype(np.int64)
@@ -120,6 +123,7 @@ class FlatIndex(base.TpuIndex):
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
         rows = self.store.rows(np.asarray(ids))
         if self.codec == "sq8":
+            # graftlint: ok(host-sync): reconstruct returns host rows by contract
             return np.asarray(sq.sq8_decode(jnp.asarray(rows), self.sq_params["vmin"], self.sq_params["span"]))
         return np.asarray(rows, np.float32)
 

@@ -39,6 +39,7 @@ import numpy as np
 
 from distributed_faiss_tpu.models import base
 from distributed_faiss_tpu.ops import distance, kmeans, pq, sq
+from distributed_faiss_tpu.utils import sanitize
 
 logger = logging.getLogger()
 
@@ -572,8 +573,12 @@ class IVFFlatIndex(_IVFBase):
     def _scan_norms(self):
         if not (self.use_stored_norms and self.norm_lists is not None):
             return None
-        assert self.norm_lists.cap == self.lists.cap, \
-            "norm/payload list capacities diverged"
+        if self.norm_lists.cap != self.lists.cap:
+            # loud failure (survives python -O, unlike an assert): stale
+            # (slot, pos) norm gathers would silently corrupt l2 scores
+            raise RuntimeError(
+                f"norm/payload list capacities diverged "
+                f"({self.norm_lists.cap} != {self.lists.cap})")
         return self.norm_lists.data
 
     def _validate_flat_pallas(self, scan) -> None:
@@ -626,10 +631,14 @@ class IVFFlatIndex(_IVFBase):
         scan_k = k * self.refine_k_factor if self.refine_k_factor else k
 
         def scan(b, with_pallas):
-            return _ivf_flat_search(
+            # maybe_checked = GRAFT_SANITIZE=1 checkify wrapper (identity
+            # when off); scalar knobs ride as kwargs so the sanitizer can
+            # partial-bind them before checkify abstracts the operands
+            return sanitize.maybe_checked(
+                _ivf_flat_search,
                 self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
-                b, scan_k, nprobe, g, self.metric, self.codec,
-                list_norms=norms, use_pallas=with_pallas,
+                b, k=scan_k, nprobe=nprobe, g=g, metric=self.metric,
+                codec=self.codec, list_norms=norms, use_pallas=with_pallas,
                 scan_bf16=self.scan_bf16, **extra,
             )
 
@@ -648,11 +657,13 @@ class IVFFlatIndex(_IVFBase):
         def run_fused(q3):
             return pallas_guarded(
                 self,
-                lambda p: _ivf_flat_search_fused(
+                lambda p: sanitize.maybe_checked(
+                    _ivf_flat_search_fused,
                     self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
                     self.refine_store.data if self.refine_k_factor else None,
-                    q3, k, scan_k, nprobe, g, self.metric, self.codec,
-                    bool(self.refine_k_factor), list_norms=norms,
+                    q3, k=k, scan_k=scan_k, nprobe=nprobe, g=g,
+                    metric=self.metric, codec=self.codec,
+                    refine=bool(self.refine_k_factor), list_norms=norms,
                     use_pallas=p, scan_bf16=self.scan_bf16, **extra,
                 ),
                 0, 0, shape=tuple(q3.shape),
@@ -663,6 +674,7 @@ class IVFFlatIndex(_IVFBase):
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
         rows = self._device_rows(ids)
         if self.codec == "sq8":
+            # graftlint: ok(host-sync): reconstruct returns host rows by contract
             return np.asarray(sq.sq8_decode(jnp.asarray(rows), self.sq_params["vmin"], self.sq_params["span"]))
         return rows.astype(np.float32)
 
@@ -1015,10 +1027,11 @@ class IVFPQIndex(_IVFBase):
         adc_k = k * self.refine_k_factor if self.refine_k_factor else k
 
         def adc(b, with_pallas):
-            return _ivf_pq_search(
+            return sanitize.maybe_checked(
+                _ivf_pq_search,
                 self.centroids, self.codebooks, self.lists.data, self.lists.ids,
-                self.lists.sizes, b, adc_k, nprobe, g, self.metric,
-                use_pallas=with_pallas,
+                self.lists.sizes, b, k=adc_k, nprobe=nprobe, g=g,
+                metric=self.metric, use_pallas=with_pallas,
                 lut_bf16=with_pallas and self.adc_lut_bf16,
             )
 
@@ -1032,11 +1045,12 @@ class IVFPQIndex(_IVFBase):
             return vals, ids
 
         def adc_fused(q3, with_pallas):
-            return _ivf_pq_search_fused(
+            return sanitize.maybe_checked(
+                _ivf_pq_search_fused,
                 self.centroids, self.codebooks, self.lists.data, self.lists.ids,
                 self.lists.sizes,
                 self.refine_store.data if self.refine_k_factor else None,
-                q3, k, adc_k, nprobe, g, self.metric,
+                q3, k=k, adc_k=adc_k, nprobe=nprobe, g=g, metric=self.metric,
                 use_pallas=with_pallas,
                 lut_bf16=with_pallas and self.adc_lut_bf16,
                 refine=bool(self.refine_k_factor),
@@ -1054,6 +1068,7 @@ class IVFPQIndex(_IVFBase):
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
         ids = np.asarray(ids, np.int64)
         codes = self._device_rows(ids)
+        # graftlint: ok(host-sync): reconstruct returns host rows by contract
         rec = np.asarray(pq.pq_decode(jnp.asarray(codes), self.codebooks))
         if self.metric == "l2":
             assign = self._host_assign_array()[ids]

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_faiss_tpu.utils import sanitize
+
 NEG_INF = -jnp.inf
 
 # fp32 MXU passes for distance math: bf16 matmul precision perturbs scores
@@ -205,5 +207,8 @@ def knn(q, x, k: int, metric: str = "l2", ntotal=None, chunk: int = 65536,
         # chunk-aligned so this path is cold.
         newcap = ((cap + chunk - 1) // chunk) * chunk
         x = jnp.pad(x, ((0, newcap - cap), (0, 0)))
-    return _knn_scan(q, x, jnp.asarray(ntotal, jnp.int32), k, metric, chunk,
-                     codec, vmin, span)
+    # maybe_checked: GRAFT_SANITIZE=1 runs the scan under checkify
+    # (NaN + OOB-gather checks); identity passthrough otherwise
+    return sanitize.maybe_checked(
+        _knn_scan, q, x, jnp.asarray(ntotal, jnp.int32), k=k, metric=metric,
+        chunk=chunk, codec=codec, vmin=vmin, span=span)

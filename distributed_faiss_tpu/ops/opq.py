@@ -31,7 +31,8 @@ def _reconstruct(xr, m: int, codebooks):
 @jax.jit
 def _procrustes(x, xhat):
     """R = U V^T minimizing ||x R - xhat||_F over orthonormal-column R."""
-    g = jnp.einsum("nd,ne->de", x, xhat, precision=jax.lax.Precision.HIGHEST)
+    g = jnp.einsum("nd,ne->de", x, xhat, precision=jax.lax.Precision.HIGHEST,
+                   preferred_element_type=jnp.float32)
     u, _, vt = jnp.linalg.svd(g, full_matrices=False)
     return u @ vt
 

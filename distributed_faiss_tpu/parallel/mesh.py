@@ -356,6 +356,7 @@ class ShardedFlatIndex(base.TpuIndex):
         bucket = base._next_pow2(ids.size, 1024)
         fidx = np.zeros(bucket, np.int64)
         fidx[:ids.size] = ids
+        # graftlint: ok(host-sync): reconstruct returns host rows by contract
         return np.asarray(_take_rows(self._dev, jnp.asarray(fidx)))[:ids.size]
 
     def state_dict(self) -> Dict[str, np.ndarray]:
@@ -529,6 +530,10 @@ class ShardedPaddedLists:
                        P(AXIS, None)),
             check_vma=False,
         )
+        # fn closes over the post-grow shard_map specs, so the program is
+        # shape-keyed anyway; appends re-trace only on capacity doubling
+        # (O(log n) times over an index's lifetime)
+        # graftlint: ok(recompile-hazard): shape-keyed closure, cold growth path
         self.data, self.ids = jax.jit(fn, donate_argnums=(0, 1))(
             self.data, self.ids, pos, payload, gids
         )

@@ -20,6 +20,8 @@ Frame layout (little-endian):
   narr x [ dtype_len u8 | dtype utf8 | ndim u8 | dims u64* | data bytes ]
 """
 
+import io
+import os
 import pickle
 import socket
 import struct
@@ -29,6 +31,72 @@ import time
 import numpy as np
 
 DEFAULT_PORT = 12032  # same default port as the reference (rpc.py:22)
+
+# ---------------------------------------------------------------- unpickling
+#
+# The frame skeleton is pickled bytes read off a TCP socket; a bare
+# pickle.loads there is remote code execution by design (GLOBAL/REDUCE
+# opcodes resolve and call any importable callable). The reference inherits
+# exactly this exposure (distributed_faiss/rpc.py FileSock pickle streams).
+# _RestrictedUnpickler resolves only what RPC payloads legitimately
+# contain: numpy array/scalar reconstruction, a safe builtins subset
+# (containers that pickle via REDUCE), and the three package types the RPC
+# surface actually ships (IndexCfg, IndexState, _TensorRef) — as EXACT
+# (module, name) pairs, never a namespace prefix. Two reasons exact pairs
+# are load-bearing: protocol >= 4 find_class getattr-walks DOTTED names,
+# so a prefix match would let a crafted frame resolve e.g.
+# ("<package>.parallel.rpc", "os.system") through this module's own
+# imports; and whole-namespace trust would let REDUCE call any package
+# callable with attacker-chosen args (SSRF via Client(...), etc.).
+# Operators shipping custom metadata classes can opt out with
+# DFT_RPC_UNSAFE_PICKLE=1 (documented in docs/LINTING.md#pickle-safety).
+
+_SAFE_BUILTINS = frozenset({
+    "set", "frozenset", "complex", "bytearray", "slice", "range",
+})
+_SAFE_NUMPY = frozenset({
+    "ndarray", "dtype", "_reconstruct", "scalar", "bool_",
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "longlong", "ulonglong",
+})
+_PACKAGE = __name__.split(".")[0]
+_SAFE_PACKAGE_GLOBALS = frozenset({
+    (f"{_PACKAGE}.utils.config", "IndexCfg"),
+    (f"{_PACKAGE}.utils.state", "IndexState"),
+    (__name__, "_TensorRef"),
+})
+
+
+def _unsafe_pickle_ok() -> bool:
+    return os.environ.get("DFT_RPC_UNSAFE_PICKLE", "0") == "1"
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        # "." in name would getattr-traverse past the allowlisted symbol
+        # (proto >= 4 dotted-name resolution); every branch requires an
+        # exact, dot-free name
+        if "." not in name:
+            if module == "builtins" and name in _SAFE_BUILTINS:
+                return super().find_class(module, name)
+            if (module == "numpy" or module.startswith(("numpy.core.",
+                                                        "numpy._core."))) \
+                    and name in _SAFE_NUMPY:
+                return super().find_class(module, name)
+            if (module, name) in _SAFE_PACKAGE_GLOBALS:
+                return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"RPC payload references disallowed global {module}.{name} "
+            "(set DFT_RPC_UNSAFE_PICKLE=1 to trust peers with arbitrary "
+            "pickles)"
+        )
+
+
+def restricted_loads(data) -> object:
+    """``pickle.loads`` for wire bytes, through the allowlisted Unpickler."""
+    if _unsafe_pickle_ok():
+        return pickle.loads(data)  # graftlint: ok(pickle-safety): explicit operator opt-out
+    return _RestrictedUnpickler(io.BytesIO(bytes(data))).load()
 
 MAGIC = b"DFT1"
 KIND_CALL = 0
@@ -133,7 +201,7 @@ def recv_frame(sock: socket.socket):
     magic, kind, skel_len, narr = _HDR.unpack(head)
     if magic != MAGIC:
         raise RuntimeError(f"bad frame magic {bytes(magic)!r}")
-    skel = pickle.loads(_recv_exact(sock, skel_len))
+    skel = restricted_loads(_recv_exact(sock, skel_len))
     arrays = []
     for _ in range(narr):
         (dt_len,) = struct.unpack("<B", _recv_exact(sock, 1))
@@ -180,6 +248,7 @@ class Client:
         self._shutdown = False
         self._next_redial = 0.0
 
+    # graftlint: ok(lock-discipline): called only from __init__ (pre-threading) and generic_fun (holding _lock)
     def _connect(self, connect_timeout: float) -> None:
         # a server may register in the discovery file moments before its
         # accept loop is up (the reference has the same gap,
@@ -265,16 +334,19 @@ class Client:
         return call
 
     def close(self):
-        if self._shutdown:
-            return
-        self._shutdown = True  # user-initiated: no auto-reconnect after this
-        if self._closed:
-            return
-        self._closed = True
-        try:
-            with self._lock:
+        # the whole teardown runs under the call lock: the unlocked flag
+        # flips of the previous version could race a concurrent
+        # generic_fun (double CLOSE frame / closing a socket mid-call)
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True  # user-initiated: no auto-reconnect after this
+            if self._closed:
+                return
+            self._closed = True
+            try:
                 send_frame(self.sock, KIND_CLOSE, None)
-        except OSError:
-            pass
-        finally:
-            self.sock.close()
+            except OSError:
+                pass
+            finally:
+                self.sock.close()

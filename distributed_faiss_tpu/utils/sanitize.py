@@ -1,0 +1,72 @@
+"""GRAFT_SANITIZE=1: checkify runtime checks on jitted search entry points.
+
+graftlint (tools/graftlint) proves the *static* invariants; this module is
+its runtime twin, in the spirit of ``jax.experimental.checkify``'s
+functionalized error checking: with ``GRAFT_SANITIZE=1`` in the
+environment, the jitted scan/search programs run under
+``checkify.checkify`` with NaN and out-of-bounds-gather checks, and a
+tripped check raises on the host instead of silently poisoning scores
+(a NaN score would propagate through top-k merges into served results;
+an OOB gather clamps silently on TPU).
+
+Cost model: checkify re-traces the wrapped program and threads an error
+token through it — multi-x slower, so this is a test-tier knob
+(``pytest -m sanitize`` — tests/test_sanitize.py re-runs the engine and
+model suites under it), never a serving default. ``enabled()`` reads the
+environment per call so a test can flip it with monkeypatch; the wrapped
+callables are cached per (fn, static-kwargs) so the sanitizer tier pays
+one re-trace per program variant, mirroring jit's own cache keying.
+
+Call-site contract (``maybe_checked``): array operands positionally or as
+array kwargs; Python-scalar kwargs (bool/int/str) are bound with
+functools.partial BEFORE checkify sees them — checkify abstracts every
+argument it is handed, and a raw string/bool operand would fail
+abstraction (they are static_argnames of the underlying jit anyway).
+"""
+
+import functools
+import os
+
+_ERR_CACHE = {}
+
+
+def enabled() -> bool:
+    return os.environ.get("GRAFT_SANITIZE", "0") == "1"
+
+
+def _checked(fn, static_items):
+    key = (id(fn), static_items)
+    cached = _ERR_CACHE.get(key)
+    if cached is not None:
+        return cached
+    from jax.experimental import checkify
+
+    base = functools.partial(fn, **dict(static_items)) if static_items else fn
+    checked = checkify.checkify(
+        base, errors=checkify.nan_checks | checkify.index_checks
+    )
+
+    @functools.wraps(fn)
+    def run(*args, **kwargs):
+        err, out = checked(*args, **kwargs)
+        err.throw()
+        return out
+
+    _ERR_CACHE[key] = run
+    return run
+
+
+def maybe_checked(fn, *args, **kwargs):
+    """Invoke jitted ``fn``; under GRAFT_SANITIZE=1 run it checkified.
+
+    Disabled (the default): a plain ``fn(*args, **kwargs)`` call — zero
+    overhead beyond one env read. Enabled: bool/int/str kwargs become
+    partial-bound statics, everything else stays a traced operand.
+    """
+    if not enabled():
+        return fn(*args, **kwargs)
+    static = tuple(sorted(
+        (k, v) for k, v in kwargs.items() if isinstance(v, (bool, int, str))
+    ))
+    dynamic = {k: v for k, v in kwargs.items() if not isinstance(v, (bool, int, str))}
+    return _checked(fn, static)(*args, **dynamic)

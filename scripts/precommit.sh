@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Local fast-path for the checks CI runs on every push: the graftlint
+# repo lint (stdlib-only, ~seconds) plus the lint test tier (golden
+# fixtures + CLI contract). Wire it up with:
+#   ln -s ../../scripts/precommit.sh .git/hooks/pre-commit
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "graftlint: linting distributed_faiss_tpu/ + tools/"
+python -m tools.graftlint distributed_faiss_tpu tools
+
+echo "graftlint: lint test tier"
+JAX_PLATFORMS=cpu python -m pytest tests/test_graftlint.py -q -m lint \
+    -p no:cacheprovider
+
+echo "precommit: OK"

@@ -1,0 +1,21 @@
+"""host-sync known-bad fixture: every flagged line is a hot-path sync."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _score(q, x):
+    return jnp.dot(q, x.T, preferred_element_type=jnp.float32)
+
+
+# graftlint: hot
+def serve(q, x):
+    s = _score(q, x)
+    best = s.max()            # line 16: not flagged (no coercion wrapper)
+    peak = float(s.max())     # line 17: host-sync (float over reduction)
+    one = s[0, 0].item()      # line 18: host-sync (.item())
+    host = np.asarray(_score(q, x))   # line 19: host-sync (np over jitted)
+    dev = jax.device_get(s)   # line 20: host-sync (device_get)
+    return best, peak, one, host, dev

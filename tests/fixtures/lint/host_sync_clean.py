@@ -1,0 +1,24 @@
+"""host-sync known-clean fixture: hot path with one explicit np host op."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _score(q, x):
+    return jnp.dot(q, x.T, preferred_element_type=jnp.float32)
+
+
+# graftlint: hot
+def serve(q, x):
+    vals = _score(q, x)
+    out = np.empty(vals.shape, np.float32)
+    out[:] = vals  # buffer-protocol fetch, the designed one-per-block sync
+    peak = np.max(out, initial=0.0)  # explicit np.* host reduction: clean
+    return out, peak
+
+
+def cold_path(q, x):
+    # not hot, not annotated: coercions here are off the serving path
+    return float(_score(q, x).max())

@@ -1,0 +1,25 @@
+"""lock-discipline known-bad fixture: majority-vote inference catches the
+one unlocked access of an otherwise locked attribute."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self.entries = {}
+        self.lock = threading.Lock()
+
+    def put(self, key, value):
+        with self.lock:
+            self.entries[key] = value
+
+    def get_one(self, key):
+        with self.lock:
+            return self.entries.get(key)
+
+    def drop(self, key):
+        with self.lock:
+            self.entries.pop(key, None)
+
+    def size_racy(self):
+        return len(self.entries)  # line 25: unlocked minority access

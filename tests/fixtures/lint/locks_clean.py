@@ -1,0 +1,27 @@
+"""lock-discipline known-clean fixture: every guarded access under its
+lock; immutable attributes and inline lambdas stay lock-free."""
+
+import threading
+
+
+class Registry:
+    def __init__(self, name):
+        self.name = name  # written only in __init__: immutable, lock-free
+        self.entries = {}
+        self.lock = threading.Lock()
+
+    def put(self, key, value):
+        with self.lock:
+            self.entries[key] = value
+
+    def size(self):
+        with self.lock:
+            return len(self.entries)
+
+    def snapshot_sorted(self):
+        with self.lock:
+            # inline lambda inherits the lock context (runs inline)
+            return sorted(self.entries.items(), key=lambda kv: len(self.entries) and kv[0])
+
+    def label(self):
+        return self.name

@@ -1,0 +1,16 @@
+"""dtype-discipline known-bad fixture (lives under ops/ to be in scope)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def scores(q, x):
+    ip = jnp.einsum("qd,nd->qn", q, x)  # line 8: no preferred_element_type
+    return ip
+
+
+def scan_bf16(q, x):
+    return jax.lax.dot_general(  # line 13: bf16 operands, implicit accum
+        q.astype(jnp.bfloat16), x.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())),
+    )

@@ -1,0 +1,18 @@
+"""dtype-discipline known-clean fixture."""
+
+import jax
+import jax.numpy as jnp
+
+
+def scores(q, x):
+    return jnp.einsum("qd,nd->qn", q, x,
+                      precision=jax.lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+
+
+def scan_bf16(q, x):
+    return jax.lax.dot_general(
+        q.astype(jnp.bfloat16), x.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )

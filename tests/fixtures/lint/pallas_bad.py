@@ -1,0 +1,20 @@
+"""pallas-guard known-bad fixture: a kernel outside ops/*_pallas.py and a
+public entry point reaching it without pallas_guarded."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def _double(x):  # line 13: pallas_call outside ops/*_pallas.py
+    return pl.pallas_call(
+        _kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )(x)
+
+
+def serve(x):  # line 19: public route into the kernel, no guard
+    return _double(x)

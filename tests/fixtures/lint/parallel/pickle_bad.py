@@ -1,0 +1,18 @@
+"""pickle-safety known-bad fixture (lives under parallel/ to be in scope)."""
+
+import pickle
+
+DEFAULTS = pickle.loads(b"\x80\x04N.")  # line 5: module-level bare loads
+
+
+def recv_payload(raw: bytes):
+    return pickle.loads(raw)  # line 9: bare loads on wire bytes
+
+
+def recv_stream(fileobj):
+    return pickle.load(fileobj)  # line 13: bare load on a socket file
+
+
+if True:  # version-gate pattern: still visible to the checker
+    def recv_gated(raw: bytes):
+        return pickle.loads(raw)  # line 18: bare loads under an if block

@@ -1,0 +1,19 @@
+"""pickle-safety known-clean fixture: the allowlisted Unpickler pattern."""
+
+import io
+import pickle
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if module == "builtins" and name in ("set", "frozenset"):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(f"disallowed {module}.{name}")
+
+
+def restricted_loads(data):
+    return _RestrictedUnpickler(io.BytesIO(bytes(data))).load()
+
+
+def recv_payload(raw: bytes):
+    return restricted_loads(raw)

@@ -1,0 +1,24 @@
+"""recompile-hazard known-bad fixture."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk_scan(x, k: int, chunk: int = 512):  # line 10: chunk not static
+    del chunk
+    return jax.lax.top_k(x, k)
+
+
+@jax.jit
+def branchy(x, threshold):
+    if threshold > 0:  # line 17: Python branch on traced param
+        return x * threshold
+    return x
+
+
+def dispatch(x):
+    fn = jax.jit(lambda v: v * 2)  # line 23: inline jit per call
+    return fn(x)

@@ -1,0 +1,25 @@
+"""recompile-hazard known-clean fixture."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_double = jax.jit(lambda v: v * 2)  # module-level: one cache entry
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk", "metric"))
+def topk_scan(x, k: int, chunk: int = 512, metric: str = "l2"):
+    del chunk, metric
+    return jax.lax.top_k(x, k)
+
+
+@jax.jit
+def masked(x, vmin=None):
+    if vmin is None:  # structural `is None` branch: clean
+        return x
+    return jnp.maximum(x, vmin)
+
+
+def dispatch(x):
+    return _double(x)

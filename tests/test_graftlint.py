@@ -1,0 +1,166 @@
+"""graftlint test tier (marked ``lint``, runs under tier-1).
+
+Three layers:
+- golden fixtures: each checker fires on its known-bad fixture at EXACT
+  (rule, line) locations and stays silent on its known-clean twin;
+- the self-enforcing repo lint: ``distributed_faiss_tpu/`` + ``tools/``
+  must produce zero findings — a regression that re-introduces a host
+  sync, an unlocked access, an unguarded kernel route, or a bare
+  ``pickle.loads`` fails the ordinary test run;
+- the CLI: exit codes and ``--format=json`` shape.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.graftlint import lint_paths
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join("tests", "fixtures", "lint")
+
+
+def _lint(relpath):
+    cwd = os.getcwd()
+    os.chdir(REPO)
+    try:
+        return lint_paths([relpath])
+    finally:
+        os.chdir(cwd)
+
+
+def _locs(findings):
+    return sorted((f.rule, f.line) for f in findings)
+
+
+# ---------------------------------------------------------------- fixtures
+
+def test_host_sync_bad_fixture():
+    assert _locs(_lint(f"{FIX}/host_sync_bad.py")) == [
+        ("host-sync", 17),  # float(s.max())
+        ("host-sync", 18),  # .item()
+        ("host-sync", 19),  # np.asarray over a jitted call
+        ("host-sync", 20),  # jax.device_get
+    ]
+
+
+def test_host_sync_clean_fixture():
+    assert _lint(f"{FIX}/host_sync_clean.py") == []
+
+
+def test_recompile_bad_fixture():
+    assert _locs(_lint(f"{FIX}/recompile_bad.py")) == [
+        ("recompile-hazard", 10),  # non-static scalar param
+        ("recompile-hazard", 17),  # branch on traced param
+        ("recompile-hazard", 23),  # inline jax.jit
+    ]
+
+
+def test_recompile_clean_fixture():
+    assert _lint(f"{FIX}/recompile_clean.py") == []
+
+
+def test_dtype_bad_fixture():
+    assert _locs(_lint(f"{FIX}/ops/dtype_bad.py")) == [
+        ("dtype-discipline", 8),   # einsum, implicit accumulation
+        ("dtype-discipline", 13),  # bf16 dot_general, implicit accumulation
+    ]
+
+
+def test_dtype_clean_fixture():
+    assert _lint(f"{FIX}/ops/dtype_clean.py") == []
+
+
+def test_locks_bad_fixture():
+    assert _locs(_lint(f"{FIX}/locks_bad.py")) == [
+        ("lock-discipline", 25),  # unlocked minority access
+    ]
+
+
+def test_locks_clean_fixture():
+    assert _lint(f"{FIX}/locks_clean.py") == []
+
+
+def test_pallas_guard_bad_fixture():
+    assert _locs(_lint(f"{FIX}/pallas_bad.py")) == [
+        ("pallas-guard", 13),  # pallas_call outside ops/*_pallas.py
+        ("pallas-guard", 19),  # unguarded public route into the kernel
+    ]
+
+
+def test_pallas_guard_clean_fixture():
+    assert _lint(f"{FIX}/ops/clean_pallas.py") == []
+
+
+def test_pickle_bad_fixture():
+    assert _locs(_lint(f"{FIX}/parallel/pickle_bad.py")) == [
+        ("pickle-safety", 5),   # module-level pickle.loads
+        ("pickle-safety", 9),   # pickle.loads
+        ("pickle-safety", 13),  # pickle.load
+        ("pickle-safety", 18),  # pickle.loads under a module-level if
+    ]
+
+
+def test_pickle_clean_fixture():
+    assert _lint(f"{FIX}/parallel/pickle_clean.py") == []
+
+
+def test_suppression_silences_bad_fixture(tmp_path):
+    src = open(os.path.join(REPO, FIX, "parallel", "pickle_bad.py")).read()
+    sub = tmp_path / "parallel"
+    sub.mkdir()
+    patched = src.replace(
+        "return pickle.loads(raw)  # line 9: bare loads on wire bytes",
+        "return pickle.loads(raw)  # graftlint: ok(pickle-safety): test",
+    )
+    (sub / "pickle_bad.py").write_text(patched)
+    findings = lint_paths([str(sub / "pickle_bad.py")])
+    assert _locs(findings) == [
+        ("pickle-safety", 5), ("pickle-safety", 13), ("pickle-safety", 18)]
+
+
+# ---------------------------------------------------------- self-enforcing
+
+def test_repo_is_lint_clean():
+    findings = _lint("distributed_faiss_tpu") + _lint("tools")
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ----------------------------------------------------------------- the CLI
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_clean_repo_exits_zero():
+    proc = _cli("distributed_faiss_tpu", "tools")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_cli_bad_fixture_exits_one_with_json():
+    proc = _cli("--format=json", f"{FIX}/parallel/pickle_bad.py")
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["count"] == 4
+    assert {f["rule"] for f in payload["findings"]} == {"pickle-safety"}
+    assert all(
+        set(f) == {"rule", "path", "line", "col", "message"}
+        for f in payload["findings"]
+    )
+
+
+def test_cli_list_rules():
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in ("host-sync", "recompile-hazard", "dtype-discipline",
+                 "lock-discipline", "pallas-guard", "pickle-safety"):
+        assert rule in proc.stdout

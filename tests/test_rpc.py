@@ -303,3 +303,120 @@ def test_client_auto_reconnect_and_cooldown():
     with pytest.raises(RuntimeError):
         cli.echo(6)
     lsock.close()
+
+
+# ------------------------------------------------------- restricted pickle
+
+
+def test_restricted_loads_roundtrips_rpc_payload_types():
+    """Everything the RPC surface legitimately pickles must survive the
+    allowlisted Unpickler: skeleton containers, numpy object arrays and
+    scalars, package types (IndexCfg, IndexState, _TensorRef)."""
+    import pickle
+
+    from distributed_faiss_tpu.utils.config import IndexCfg
+    from distributed_faiss_tpu.utils.state import IndexState
+
+    def roundtrip(obj):
+        return rpc.restricted_loads(pickle.dumps(obj, protocol=4))
+
+    # exact content equality for containers and scalars
+    skel = [("meta", 0), None, {1, 2}, frozenset({3}), b"bytes", 1.5, range(3)]
+    assert roundtrip(skel) == skel
+    call = ("search", ("idx", 10), {"return_embeddings": False})
+    assert roundtrip(call) == call
+    assert roundtrip(IndexState.TRAINED) is IndexState.TRAINED
+    assert roundtrip(np.float32(1.25)) == np.float32(1.25)
+    assert roundtrip(np.int64(7)) == np.int64(7)
+
+    ref = roundtrip(rpc._TensorRef(3))
+    assert isinstance(ref, rpc._TensorRef) and ref.idx == 3
+    nested = roundtrip(("call", (rpc._TensorRef(1), rpc._TensorRef(2))))
+    assert [r.idx for r in nested[1]] == [1, 2]
+
+    cfg = roundtrip(IndexCfg(dim=16, metric="dot"))
+    assert cfg.dim == 16 and cfg.metric == "dot"
+
+    obj_arr = np.empty(2, dtype=object)
+    obj_arr[0] = ("doc", 1)
+    obj_arr[1] = None
+    out = roundtrip(obj_arr)
+    assert out.dtype == object and out[0] == ("doc", 1) and out[1] is None
+
+
+def test_restricted_loads_rejects_dangerous_globals(monkeypatch):
+    """A crafted frame referencing an arbitrary callable must raise
+    UnpicklingError instead of resolving it (remote-code-execution vector
+    of bare pickle.loads); DFT_RPC_UNSAFE_PICKLE=1 is the explicit
+    operator opt-out."""
+    import os
+    import pickle
+    import pickletools
+
+    class Evil:
+        def __reduce__(self):
+            return (os.getenv, ("HOME",))
+
+    blob = pickle.dumps(Evil(), protocol=4)
+    assert b"getenv" in pickletools.optimize(blob)
+    monkeypatch.delenv("DFT_RPC_UNSAFE_PICKLE", raising=False)
+    with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        rpc.restricted_loads(blob)
+    # builtins outside the safe subset are rejected too
+    evil_builtin = pickle.dumps(eval, protocol=4)
+    with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        rpc.restricted_loads(evil_builtin)
+    # proto-4 STACK_GLOBAL with a DOTTED name: CPython's find_class
+    # getattr-walks "os.getenv" through the rpc module's own imports, so a
+    # package-module reference must not bypass the allowlist (the exploit
+    # a namespace-prefix allowlist permits)
+
+    def _short_unicode(s):
+        b = s.encode()
+        return b"\x8c" + bytes([len(b)]) + b
+
+    dotted = (b"\x80\x04"
+              + _short_unicode("distributed_faiss_tpu.parallel.rpc")
+              + _short_unicode("os.getenv")
+              + b"\x93.")  # STACK_GLOBAL, STOP
+    with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        rpc.restricted_loads(dotted)
+    # arbitrary package callables (even dot-free) are rejected: only the
+    # three RPC-surface types resolve
+    evil_pkg = (b"\x80\x04"
+                + _short_unicode("distributed_faiss_tpu.parallel.rpc")
+                + _short_unicode("Client")
+                + b"\x93.")
+    with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+        rpc.restricted_loads(evil_pkg)
+    # explicit opt-out restores reference behavior for custom metadata
+    monkeypatch.setenv("DFT_RPC_UNSAFE_PICKLE", "1")
+    assert rpc.restricted_loads(blob) == os.getenv("HOME")
+
+
+def test_wire_frames_decode_through_restricted_unpickler():
+    """recv_frame's skeleton path uses restricted_loads end to end."""
+    import io
+
+    parts = rpc.pack_frame(
+        rpc.KIND_CALL,
+        ("add_index_data", (np.arange(6, dtype=np.float32).reshape(2, 3),
+                            [("m", 0), ("m", 1)]), {}),
+    )
+
+    class FakeSock:
+        def __init__(self, data):
+            self.buf = io.BytesIO(data)
+
+        def recv_into(self, view, n):
+            chunk = self.buf.read(n)
+            view[: len(chunk)] = chunk
+            return len(chunk)
+
+    kind, payload = rpc.recv_frame(FakeSock(b"".join(bytes(p) for p in parts)))
+    assert kind == rpc.KIND_CALL
+    fname, args, kwargs = payload
+    assert fname == "add_index_data"
+    np.testing.assert_array_equal(
+        args[0], np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert args[1] == [("m", 0), ("m", 1)]

@@ -1,0 +1,110 @@
+"""Sanitizer tier: GRAFT_SANITIZE=1 checkify runs (non-default marker).
+
+Marked ``sanitize`` AND ``slow``: tier-1 (``-m 'not slow'``) never pays the
+checkify re-trace cost; run explicitly with ``pytest -m sanitize``. The
+subprocess test is the satellite the sanitizer exists for — the whole
+engine/model suites re-run under NaN + OOB-gather runtime checks.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.models.flat import FlatIndex
+from distributed_faiss_tpu.models.ivf import IVFFlatIndex, IVFPQIndex
+from distributed_faiss_tpu.utils import sanitize
+
+pytestmark = [pytest.mark.sanitize, pytest.mark.slow]
+
+
+@pytest.fixture
+def sanitized(monkeypatch):
+    monkeypatch.setenv("GRAFT_SANITIZE", "1")
+
+
+def _data(n=400, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, d)).astype(np.float32),
+            rng.standard_normal((8, d)).astype(np.float32))
+
+
+def test_enabled_reads_env(monkeypatch):
+    monkeypatch.delenv("GRAFT_SANITIZE", raising=False)
+    assert not sanitize.enabled()
+    monkeypatch.setenv("GRAFT_SANITIZE", "1")
+    assert sanitize.enabled()
+
+
+def test_flat_clean_data_matches_unsanitized(sanitized, monkeypatch):
+    x, q = _data()
+    idx = FlatIndex(16, "l2")
+    idx.add(x)
+    d1, i1 = idx.search(q, 5)
+    monkeypatch.setenv("GRAFT_SANITIZE", "0")
+    d0, i0 = idx.search(q, 5)
+    np.testing.assert_array_equal(i1, i0)
+    np.testing.assert_allclose(d1, d0)
+
+
+def test_ivf_flat_clean_data_matches_unsanitized(sanitized, monkeypatch):
+    x, q = _data()
+    idx = IVFFlatIndex(16, 8, "l2")
+    idx.train(x)
+    idx.add(x)
+    idx.set_nprobe(4)
+    d1, i1 = idx.search(q, 5)
+    monkeypatch.setenv("GRAFT_SANITIZE", "0")
+    d0, i0 = idx.search(q, 5)
+    np.testing.assert_array_equal(i1, i0)
+    np.testing.assert_allclose(d1, d0)
+
+
+def test_ivf_pq_clean_data_passes(sanitized):
+    x, q = _data(n=600)
+    idx = IVFPQIndex(16, 8, m=4)
+    idx.train(x)
+    idx.add(x)
+    idx.set_nprobe(4)
+    d, i = idx.search(q, 5)
+    assert np.isfinite(d).all() and (i >= 0).all()
+
+
+def test_nan_query_raises(sanitized):
+    x, q = _data()
+    idx = FlatIndex(16, "l2")
+    idx.add(x)
+    qb = q.copy()
+    qb[0, 0] = np.nan
+    with pytest.raises(Exception, match="(?i)nan"):
+        idx.search(qb, 5)
+
+
+def test_nan_query_raises_ivf(sanitized):
+    x, q = _data()
+    idx = IVFFlatIndex(16, 8, "l2")
+    idx.train(x)
+    idx.add(x)
+    idx.set_nprobe(4)
+    qb = q.copy()
+    qb[0, 0] = np.nan
+    with pytest.raises(Exception, match="(?i)nan"):
+        idx.search(qb, 5)
+
+
+def test_engine_and_models_suites_under_sanitizer():
+    """The sanitizer-tier satellite: re-run test_engine.py + test_models.py
+    with GRAFT_SANITIZE=1 — every jitted scan/search those suites drive
+    runs under checkify NaN/OOB checks."""
+    env = dict(os.environ, GRAFT_SANITIZE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_engine.py",
+         "tests/test_models.py", "-q", "-m", "not slow",
+         "-p", "no:cacheprovider"],
+        env=env, capture_output=True, text=True, timeout=1200,
+    )
+    assert proc.returncode == 0, (
+        f"sanitized suite failed:\n{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}"
+    )

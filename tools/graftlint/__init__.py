@@ -1,0 +1,19 @@
+"""graftlint: repo-native static analysis for the JAX/Pallas/threading
+invariants the serving hot path depends on.
+
+Usage:
+    python -m tools.graftlint [paths] [--format=json]
+
+Library surface:
+    from tools.graftlint import lint_paths, Finding
+    findings = lint_paths(["distributed_faiss_tpu"])
+
+Checkers, suppression syntax (``# graftlint: ok(<rule>)``) and the
+hot-path/lock annotation conventions are documented in docs/LINTING.md.
+"""
+
+from tools.graftlint.core import Finding, lint_paths  # noqa: F401
+
+__version__ = "0.1.0"
+
+DEFAULT_PATHS = ("distributed_faiss_tpu", "tools")

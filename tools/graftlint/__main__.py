@@ -1,0 +1,54 @@
+"""CLI: ``python -m tools.graftlint [paths] [--format=text|json]``.
+
+Exit status: 0 when clean, 1 when findings, 2 on usage errors. Runs
+standalone (stdlib-only: ast) and under tier-1 via tests/test_graftlint.py
+(the self-enforcing lint of the whole repo, marked ``lint``).
+"""
+
+import argparse
+import json
+import sys
+
+from tools.graftlint import DEFAULT_PATHS, __version__, lint_paths
+from tools.graftlint import checks
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="static analysis for JAX/Pallas/threading invariants",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=list(DEFAULT_PATHS),
+        help=f"files/directories to lint (default: {' '.join(DEFAULT_PATHS)})",
+    )
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print rule names and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, mod in sorted(checks.RULES.items()):
+            doc = (mod.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule}: {doc}")
+        return 0
+
+    findings = lint_paths(args.paths)
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "version": __version__,
+                "count": len(findings),
+                "findings": [f.to_dict() for f in findings],
+            },
+            indent=2,
+        ))
+    else:
+        for f in findings:
+            print(f)
+        print(f"graftlint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

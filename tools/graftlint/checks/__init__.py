@@ -1,0 +1,14 @@
+"""Checker registry. Each checker module exposes RULE and check(model)."""
+
+from tools.graftlint.checks import (
+    dtype,
+    host_sync,
+    locks,
+    pallas_guard,
+    pickle_safety,
+    recompile,
+)
+
+ALL = (host_sync, recompile, dtype, locks, pallas_guard, pickle_safety)
+
+RULES = {c.RULE: c for c in ALL}

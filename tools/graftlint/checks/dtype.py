@@ -1,0 +1,54 @@
+"""dtype-discipline: accumulation dtype must be explicit in device matmuls.
+
+Inside device-code modules (``ops/``, ``models/``, ``parallel/mesh.py``),
+every matmul-class call — ``jnp.einsum``, ``jnp.dot``, ``jnp.matmul``,
+``jnp.tensordot``, ``jax.lax.dot_general`` — must pass
+``preferred_element_type``. Without it the accumulation dtype follows the
+operand dtype: a bf16 operand silently accumulates in bf16 (precision
+collapse on long contractions), and an f32 op that someone later feeds
+bf16 storage inherits the collapse invisibly. Stating
+``preferred_element_type=jnp.float32`` makes the contract explicit and is
+a numerical no-op for f32 operands.
+
+The ``@`` operator is deliberately out of scope (used only for tiny
+host-shaped algebra like the OPQ procrustes rotation); the named APIs are
+where list-scan and ADC accumulation lives.
+"""
+
+import ast
+
+from tools.graftlint.core import Finding, attr_root, call_name
+
+RULE = "dtype-discipline"
+
+_MATMUL_NAMES = frozenset({"einsum", "dot", "matmul", "tensordot", "dot_general"})
+_DEVICE_ROOTS = frozenset({"jnp", "jax", "lax"})
+
+
+def _in_scope(mod) -> bool:
+    p = mod.relpath
+    return ("/ops/" in p or "/models/" in p or p.endswith("parallel/mesh.py")
+            or p.startswith(("ops/", "models/")))
+
+
+def check(model):
+    for mod in model.modules:
+        if not _in_scope(mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name not in _MATMUL_NAMES:
+                continue
+            root = attr_root(node.func) if isinstance(node.func, ast.Attribute) else None
+            if root not in _DEVICE_ROOTS:
+                continue  # np.dot etc: host numpy, accumulates in operand dtype by design
+            if any(kw.arg == "preferred_element_type" for kw in node.keywords):
+                continue
+            yield Finding(
+                RULE, mod.relpath, node.lineno, node.col_offset,
+                f"`{root}...{name}` without preferred_element_type: "
+                "accumulation dtype is implicit (bf16 operands would "
+                "accumulate in bf16)",
+            )

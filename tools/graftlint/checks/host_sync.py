@@ -1,0 +1,105 @@
+"""host-sync: no silent host-device synchronization on the serving hot path.
+
+Hot-path functions are the call-graph closure of ``Index.search``
+(core.HOT_ROOTS) plus anything annotated ``# graftlint: hot``. Inside them:
+
+- ``.item()`` is always a blocking device->host transfer.
+- ``jax.device_get(...)`` likewise.
+- ``np.asarray``/``np.array``/``np.ascontiguousarray`` whose argument
+  expression visibly contains a ``jnp.*`` expression or a call to a
+  known-jitted function materializes a device array on the host.
+- ``float()``/``int()``/``bool()`` coercions whose argument contains a
+  reduction method call (``.max()``, ``.any()``, ...) on a non-numpy root,
+  a ``jnp.*`` expression, or a known-jitted call: the coercion forces the
+  value to the host (and for reductions, scans the array on the serving
+  thread even when it is already host-side).
+
+Precision-first: a device array hiding in a bare local name is invisible
+to this checker; the conventions doc (docs/LINTING.md) asks hot-path code
+to keep its one designed device fetch per block behind an obvious
+``np.asarray(<jitted call>)`` or to annotate with ``# graftlint: ok``.
+"""
+
+import ast
+
+from tools.graftlint.core import (
+    Finding, NUMPY_ALIASES, attr_root, call_name, dotted,
+)
+
+RULE = "host-sync"
+
+_REDUCTIONS = frozenset({
+    "item", "max", "min", "sum", "any", "all", "argmax", "argmin", "mean",
+})
+_HOST_CASTS = frozenset({"float", "int", "bool"})
+_NP_MATERIALIZERS = frozenset({"asarray", "array", "ascontiguousarray"})
+
+
+def _mentions_device(node: ast.AST, jitted_names) -> bool:
+    """Does this expression visibly produce a device value: a ``jnp.*``
+    attribute chain or a call to a known-jitted function?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and attr_root(sub) == "jnp":
+            return True
+        if isinstance(sub, ast.Call):
+            n = call_name(sub)
+            if n in jitted_names:
+                return True
+    return False
+
+
+def _reduction_on_array(node: ast.AST) -> bool:
+    """A ``.max()``-style reduction method call whose root is not a numpy
+    module alias (``np.max(...)`` is an explicit host-side formulation)."""
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _REDUCTIONS
+                and attr_root(sub.func) not in NUMPY_ALIASES):
+            return True
+    return False
+
+
+def check(model):
+    jitted = model.jitted_names
+    for fi in model.functions:
+        if not fi.hot:
+            continue
+        mod = fi.module
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                yield Finding(
+                    RULE, mod.relpath, node.lineno, node.col_offset,
+                    f"`.item()` in hot-path function {fi.qualname} blocks on "
+                    "a device->host transfer",
+                )
+            elif d == "jax.device_get":
+                yield Finding(
+                    RULE, mod.relpath, node.lineno, node.col_offset,
+                    f"`jax.device_get` in hot-path function {fi.qualname}",
+                )
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _NP_MATERIALIZERS
+                    and attr_root(node.func) in NUMPY_ALIASES
+                    and node.args
+                    and _mentions_device(node.args[0], jitted)):
+                yield Finding(
+                    RULE, mod.relpath, node.lineno, node.col_offset,
+                    f"`np.{node.func.attr}` over a device expression in "
+                    f"hot-path function {fi.qualname} forces a host sync",
+                )
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id in _HOST_CASTS
+                    and len(node.args) == 1
+                    and (_mentions_device(node.args[0], jitted)
+                         or _reduction_on_array(node.args[0]))):
+                yield Finding(
+                    RULE, mod.relpath, node.lineno, node.col_offset,
+                    f"`{node.func.id}(...)` coercion over an array reduction "
+                    f"in hot-path function {fi.qualname}; hoist to an "
+                    "explicit np.* host op or fetch once per block",
+                )

@@ -1,0 +1,193 @@
+"""lock-discipline: mutable shared state is only touched under its lock.
+
+Scope: every linted class that owns a ``threading.Lock``/``RLock``
+attribute. Two sources decide which attributes a lock guards:
+
+- PINS: the reviewed engine/server map (the invariants PR 1's hot path
+  depends on — ``Index.tpu_index``/``state`` under ``index_lock``,
+  ``Index.embeddings_buffer``/``total_data``/``id_to_metadata`` under
+  ``buffer_lock``, ``IndexServer.indexes`` under ``indexes_lock``).
+- Inference for every other lock-owning class: an attribute accessed under
+  lock L in a STRICT MAJORITY of its uses is considered L-guarded, and the
+  minority accesses are findings. (Majority, not unanimity — otherwise the
+  violation being hunted would vote its own attribute out of the guarded
+  set.)
+
+Lexical model: a ``with self.<lock>:`` block activates the lock for its
+body. Lambdas inherit the surrounding lock context (they run inline —
+e.g. the atomic-save write lambdas); nested ``def``s reset it (they
+usually run later on another thread, e.g. watcher/worker targets).
+``__init__``/``__new__``/``__del__`` are construction/teardown
+(single-threaded by contract) and are skipped.
+"""
+
+import ast
+
+from tools.graftlint.core import Finding
+
+RULE = "lock-discipline"
+
+PINS = {
+    ("Index", "tpu_index"): "index_lock",
+    ("Index", "state"): "index_lock",
+    ("Index", "embeddings_buffer"): "buffer_lock",
+    ("Index", "total_data"): "buffer_lock",
+    ("Index", "id_to_metadata"): "buffer_lock",
+    ("IndexServer", "indexes"): "indexes_lock",
+}
+
+_SKIP_METHODS = frozenset({"__init__", "__new__", "__del__"})
+
+
+def _lock_attrs(class_node) -> set:
+    """Attributes assigned ``threading.Lock()``/``RLock()``/``Condition()``
+    anywhere in the class body."""
+    locks = set()
+    for node in ast.walk(class_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
+                and v.func.attr in ("Lock", "RLock", "Condition")):
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                locks.add(t.attr)
+    return locks
+
+
+_MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "pop", "popitem", "remove", "clear",
+    "update", "setdefault", "add", "discard", "sort", "reverse",
+})
+
+
+def _self_attr(node) -> str:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+def _mutated_attrs(class_node) -> set:
+    """Attributes mutated in any method OTHER than construction/teardown —
+    only mutable state needs a lock. Mutation = rebinding (``self.x = ...``),
+    item assignment (``self.x[k] = ...``), or an in-place container method
+    (``self.x.append(...)``)."""
+    out = set()
+    for sub in class_node.body:
+        if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if sub.name in _SKIP_METHODS:
+            continue
+        for node in ast.walk(sub):
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                attr = _self_attr(node)
+                if attr:
+                    out.add(attr)
+            elif isinstance(node, ast.Subscript) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                attr = _self_attr(node.value)
+                if attr:
+                    out.add(attr)
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATOR_METHODS):
+                attr = _self_attr(node.func.value)
+                if attr:
+                    out.add(attr)
+    return out
+
+
+class _Access:
+    __slots__ = ("attr", "line", "col", "locks_held", "method")
+
+    def __init__(self, attr, line, col, locks_held, method):
+        self.attr = attr
+        self.line = line
+        self.col = col
+        self.locks_held = locks_held
+        self.method = method
+
+
+def _collect_accesses(method_node, lock_names, method_name):
+    accesses = []
+
+    def visit(node, held):
+        if isinstance(node, ast.With):
+            new_held = set(held)
+            for item in node.items:
+                ce = item.context_expr
+                if (isinstance(ce, ast.Attribute)
+                        and isinstance(ce.value, ast.Name)
+                        and ce.value.id == "self" and ce.attr in lock_names):
+                    new_held.add(ce.attr)
+            for sub in node.body:
+                visit(sub, frozenset(new_held))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in node.body:
+                visit(sub, frozenset())  # runs later: no inherited locks
+            return
+        if isinstance(node, ast.Lambda):
+            visit(node.body, held)  # runs inline: inherits lock context
+            return
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr not in lock_names):
+            accesses.append(_Access(node.attr, node.lineno, node.col_offset,
+                                    held, method_name))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in method_node.body:
+        visit(stmt, frozenset())
+    return accesses
+
+
+def check(model):
+    for mod in model.modules:
+        for node in mod.classes:
+            lock_names = _lock_attrs(node)
+            if not lock_names:
+                continue
+            accesses = []
+            for sub in node.body:
+                if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if sub.name in _SKIP_METHODS:
+                    continue
+                accesses += _collect_accesses(sub, lock_names, sub.name)
+
+            # attribute -> guarding lock: pins first, then majority vote
+            guarded = {}
+            for (cls, attr), lock in PINS.items():
+                if cls == node.name and lock in lock_names:
+                    guarded[attr] = lock
+            mutated = _mutated_attrs(node)
+            by_attr = {}
+            for a in accesses:
+                by_attr.setdefault(a.attr, []).append(a)
+            for attr, uses in by_attr.items():
+                if attr in guarded:
+                    continue
+                if attr not in mutated:
+                    continue  # immutable after construction: lock-free reads are fine
+                for lock in lock_names:
+                    under = sum(1 for a in uses if lock in a.locks_held)
+                    if under * 2 > len(uses):
+                        guarded[attr] = lock
+                        break
+
+            for a in accesses:
+                lock = guarded.get(a.attr)
+                if lock is None or lock in a.locks_held:
+                    continue
+                yield Finding(
+                    RULE, mod.relpath, a.line, a.col,
+                    f"{node.name}.{a.method} touches `self.{a.attr}` outside "
+                    f"`with self.{lock}` (guarded attribute)",
+                )

@@ -1,0 +1,64 @@
+"""pickle-safety: network-facing unpickling goes through the allowlist.
+
+Scope: ``parallel/`` — the modules that deserialize bytes received from a
+socket. ``pickle.loads`` on wire bytes is remote code execution by design
+(a crafted frame's GLOBAL/REDUCE opcodes call any importable callable);
+the RPC skeleton must be decoded by ``rpc.restricted_loads``, whose
+Unpickler resolves only numpy payload types, a safe builtins subset, and
+this package's own RPC-surface classes (docs/LINTING.md#pickle-safety).
+
+Engine-side ``pickle.load`` of local checkpoint files (meta.pkl,
+buffer.pkl) is out of scope: those paths are operator-trusted storage,
+not the network boundary.
+"""
+
+import ast
+
+from tools.graftlint.core import Finding, attr_root, call_name
+
+RULE = "pickle-safety"
+
+_ALLOWED_QUALS = ("restricted_loads", "_RestrictedUnpickler")
+
+
+def _in_scope(mod) -> bool:
+    return "/parallel/" in mod.relpath or mod.relpath.startswith("parallel/")
+
+
+def check(model):
+    for mod in model.modules:
+        if not _in_scope(mod):
+            continue
+        # spans of the restricted loader itself (the one place allowed to
+        # touch pickle.Unpickler)
+        allowed_spans = [
+            (u.lineno, getattr(u.node, "end_lineno", u.lineno))
+            for u in mod.units
+            if any(part in _ALLOWED_QUALS for part in u.qualname.split("."))
+        ]
+        # the WHOLE module tree, not just function bodies: a module-level
+        # `pickle.loads(...)` at the network boundary is just as hot
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name not in ("loads", "load", "Unpickler"):
+                continue
+            root = (attr_root(node.func)
+                    if isinstance(node.func, ast.Attribute) else None)
+            if root != "pickle":
+                continue
+            if any(a <= node.lineno <= b for a, b in allowed_spans):
+                continue
+            where = "<module>"
+            for u in mod.units:
+                end = getattr(u.node, "end_lineno", u.lineno)
+                if u.lineno <= node.lineno <= end:
+                    where = u.qualname
+                    break
+            yield Finding(
+                RULE, mod.relpath, node.lineno, node.col_offset,
+                f"bare pickle.{name} in network-facing {where}: "
+                "use rpc.restricted_loads (allowlisted Unpickler) for "
+                "wire payloads",
+            )

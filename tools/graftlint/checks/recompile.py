@@ -1,0 +1,128 @@
+"""recompile-hazard: jit signatures that retrace or recompile per call.
+
+- R1: a jitted function whose parameter is annotated ``int``/``bool``/
+  ``str`` (or defaulted to such a constant) but is not listed in
+  ``static_argnames``/``static_argnums`` gets a fresh trace per distinct
+  value — on the serving path that is a recompile storm (every (k, nprobe)
+  combination compiles a multi-second program).
+- R2: Python ``if``/``while`` branching on a non-static parameter inside a
+  jitted function is a trace-time branch on a traced value and raises a
+  ConcretizationTypeError at best, silently bakes one branch in at worst.
+  ``is None``/``is not None`` structural checks are exempt.
+- R3: calling ``jax.jit(...)`` inline inside a function body creates a
+  fresh cache entry per call (the inner callable is a new object each
+  time); hoist to module level or bind once in ``__init__``.
+"""
+
+import ast
+
+from tools.graftlint.core import (
+    Finding, decorator_jit_info, jit_info_from_call,
+)
+
+RULE = "recompile-hazard"
+
+_SCALAR_ANN = frozenset({"int", "bool", "str"})
+
+
+def _params(node):
+    a = node.args
+    return list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+
+
+def _scalar_param_names(node):
+    """Parameter names whose annotation or default marks them as Python
+    scalars (with positional indexes for static_argnums matching)."""
+    a = node.args
+    pos = list(a.posonlyargs) + list(a.args)
+    out = {}
+    defaults = [None] * (len(pos) - len(a.defaults)) + list(a.defaults)
+    for i, (p, d) in enumerate(zip(pos, defaults)):
+        if _is_scalar(p.annotation, d):
+            out[p.arg] = i
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if _is_scalar(p.annotation, d):
+            out[p.arg] = None
+    return out
+
+
+def _is_scalar(annotation, default) -> bool:
+    if isinstance(annotation, ast.Name) and annotation.id in _SCALAR_ANN:
+        return True
+    if (isinstance(default, ast.Constant)
+            and isinstance(default.value, (bool, int, str))
+            and default.value is not None):
+        return True
+    return False
+
+
+def _structural(test: ast.AST) -> bool:
+    """`x is None` / `x is not None` and boolean combinations thereof."""
+    if isinstance(test, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+    if isinstance(test, ast.BoolOp):
+        return all(_structural(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _structural(test.operand)
+    return False
+
+
+def check(model):
+    for fi in model.functions:
+        mod = fi.module
+        jit = fi.jit
+        if jit is not None:
+            scalars = _scalar_param_names(fi.node)
+            for name, idx in scalars.items():
+                if name in jit.static_names or (
+                        idx is not None and idx in jit.static_nums):
+                    continue
+                yield Finding(
+                    RULE, mod.relpath, fi.lineno, fi.node.col_offset,
+                    f"jitted {fi.qualname} takes Python scalar `{name}` "
+                    "without static_argnames/static_argnums: every distinct "
+                    "value traces a new program",
+                )
+            static = set(jit.static_names)
+            params = {p.arg for p in _params(fi.node)}
+            traced = params - static - set(scalars)
+            for node in ast.walk(fi.node):
+                if isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                    if _structural(test):
+                        continue
+                    for sub in ast.walk(test):
+                        if isinstance(sub, ast.Name) and sub.id in traced:
+                            yield Finding(
+                                RULE, mod.relpath, test.lineno,
+                                test.col_offset,
+                                f"Python branch on traced parameter "
+                                f"`{sub.id}` inside jitted {fi.qualname}",
+                            )
+                            break
+        # R3: inline jax.jit inside any non-__init__ body
+        if fi.name in ("__init__", "__new__"):
+            continue
+        deco_nodes = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for d in node.decorator_list:
+                    deco_nodes.update(id(s) for s in ast.walk(d))
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call) and id(node) not in deco_nodes:
+                info = jit_info_from_call(node)
+                if info is not None and _is_inline_jit(node):
+                    yield Finding(
+                        RULE, mod.relpath, node.lineno, node.col_offset,
+                        f"inline jax.jit inside {fi.qualname}: a fresh cache "
+                        "entry per call; hoist to module scope or bind once",
+                    )
+
+
+def _is_inline_jit(call: ast.Call) -> bool:
+    # partial(jax.jit, ...) used as a decorator factory is handled by the
+    # decorator path; here we only flag direct jax.jit(fn, ...) calls
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "jit") or (
+        isinstance(f, ast.Name) and f.id == "jit"
+    )

@@ -1,0 +1,410 @@
+"""graftlint shared core: repo model, suppressions, findings, call graph.
+
+The checkers (tools/graftlint/checks/) enforce the invariants the serving
+hot path depends on (docs/LINTING.md); this module gives them one parsed
+view of the repo so every checker agrees on what a "function", a "jitted
+callable", or a "hot-path function" is.
+
+Design stance: checkers are PRECISION-FIRST. A finding should be worth a
+human's time, so the matchers under-approximate (a dynamic dispatch or a
+function value stored in a local is invisible to them) and the documented
+conventions (``# graftlint: hot``, ``# graftlint: ok(<rule>)``) close the
+gap explicitly instead of heuristics guessing.
+
+Analysis units come at two granularities:
+
+- ``FunctionInfo`` — outermost functions and methods. Nested defs and
+  lambdas belong to their outermost enclosing function: the hot-path walk
+  and the host-sync scan treat the whole lexical body as one unit.
+- ``Unit`` — every def/lambda separately, with parent links. The
+  pallas-guard taint analysis needs this resolution: a nested ``scan``
+  helper that reaches a kernel must not taint its enclosing ``search``
+  when every reference to it is wrapped in ``pallas_guarded``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*ok\(([^)]*)\)")
+HOT_RE = re.compile(r"#\s*graftlint:\s*hot\b")
+
+# call-graph roots for the hot-path walk (module path suffix, qualname);
+# any function annotated `# graftlint: hot` is an additional root
+HOT_ROOTS: Tuple[Tuple[str, str], ...] = (
+    ("engine.py", "Index.search"),
+)
+
+# module aliases that resolve to code outside this repo: attribute calls
+# rooted here are never treated as calls to repo functions
+EXTERNAL_ROOTS = frozenset({
+    "jax", "jnp", "lax", "pl", "pltpu", "np", "numpy", "os", "np_mod",
+    "threading", "functools", "itertools", "logging", "pickle", "json",
+    "socket", "struct", "time", "re", "math", "selectors", "pathlib",
+    "ctypes", "subprocess", "sys", "random",
+})
+
+NUMPY_ALIASES = frozenset({"np", "numpy"})
+
+# method names excluded as hot-path call-graph edges: ubiquitous container/
+# builtin method names that would otherwise alias repo functions (a
+# `seen.add(x)` inside a hot function must not mark every `Index.add` hot —
+# ingest paths are reached from `add_batch`, not `search`)
+HOT_EDGE_STOPLIST = frozenset({
+    "add", "append", "extend", "update", "pop", "get", "set", "clear",
+    "remove", "close", "record", "join", "split", "copy", "items", "keys",
+    "values", "wait", "acquire", "release", "put",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class JitInfo:
+    static_names: frozenset
+    static_nums: Tuple[int, ...]
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "jit") or (
+        isinstance(node, ast.Name) and node.id == "jit"
+    )
+
+
+def _const_items(node: ast.AST) -> list:
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts if isinstance(e, ast.Constant)]
+    return []
+
+
+def jit_info_from_call(call: ast.Call) -> Optional[JitInfo]:
+    """JitInfo for ``jax.jit(...)`` / ``functools.partial(jax.jit, ...)``
+    call expressions; None when the call is neither."""
+    f = call.func
+    is_partial = (isinstance(f, ast.Attribute) and f.attr == "partial") or (
+        isinstance(f, ast.Name) and f.id == "partial"
+    )
+    inner_jit = is_partial and call.args and _is_jit_ref(call.args[0])
+    if not (_is_jit_ref(f) or inner_jit):
+        return None
+    names: frozenset = frozenset()
+    nums: Tuple[int, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = frozenset(v for v in _const_items(kw.value) if isinstance(v, str))
+        elif kw.arg == "static_argnums":
+            nums = tuple(v for v in _const_items(kw.value) if isinstance(v, int))
+    return JitInfo(names, nums)
+
+
+def decorator_jit_info(node) -> Optional[JitInfo]:
+    for dec in node.decorator_list:
+        if _is_jit_ref(dec):
+            return JitInfo(frozenset(), ())
+        if isinstance(dec, ast.Call):
+            info = jit_info_from_call(dec)
+            if info is not None:
+                return info
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Bare name of a call target: ``f(...)`` -> "f", ``a.b.c(...)`` -> "c"."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def attr_root(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an attribute chain: ``a.b.c`` -> "a"."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Full dotted name of Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class Unit:
+    """One def/lambda, at full nesting resolution (pallas-guard taint)."""
+
+    __slots__ = (
+        "module", "name", "qualname", "node", "parent", "lineno",
+        "has_pallas_call", "calls_pallas_guarded",
+    )
+
+    def __init__(self, module, name, qualname, node, parent, lineno):
+        self.module = module
+        self.name = name  # None for lambdas
+        self.qualname = qualname
+        self.node = node
+        self.parent = parent
+        self.lineno = lineno
+        self.has_pallas_call = False
+        self.calls_pallas_guarded = False
+
+
+class FunctionInfo:
+    """One outermost function/method (nested defs included in its body)."""
+
+    __slots__ = (
+        "module", "name", "qualname", "cls", "node", "lineno", "jit",
+        "called_names", "hot", "hot_annotated",
+    )
+
+    def __init__(self, module, name, qualname, cls, node):
+        self.module = module
+        self.name = name
+        self.qualname = qualname
+        self.cls = cls  # enclosing class name or None
+        self.node = node
+        self.lineno = node.lineno
+        self.jit = decorator_jit_info(node)
+        self.called_names: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                n = call_name(sub)
+                if n:
+                    self.called_names.add(n)
+        first = min([d.lineno for d in node.decorator_list] + [node.lineno])
+        self.hot_annotated = any(
+            ln in module.hot_lines for ln in range(first - 1, node.lineno + 1)
+        )
+        self.hot = False
+
+
+def module_level_stmts(stmts):
+    """Yield defs/classes at module (or class) level, descending into
+    statement blocks (if/try/with/for/while — version gates, availability
+    guards) but never into function bodies."""
+    for s in stmts:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            yield s
+        elif isinstance(s, (ast.If, ast.Try, ast.With, ast.For, ast.While,
+                            ast.AsyncWith, ast.AsyncFor)):
+            blocks = [getattr(s, "body", []), getattr(s, "orelse", []),
+                      getattr(s, "finalbody", [])]
+            blocks += [h.body for h in getattr(s, "handlers", [])]
+            for blk in blocks:
+                yield from module_level_stmts(blk)
+
+
+class ModuleInfo:
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.hot_lines: Set[int] = set()
+        for i, text in enumerate(self.lines, 1):
+            m = SUPPRESS_RE.search(text)
+            if m:
+                self.suppressions[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+            if HOT_RE.search(text):
+                self.hot_lines.add(i)
+        # alias -> imported module dotted path (for internal/external calls)
+        self.import_aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.import_aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+        self.functions: List[FunctionInfo] = []
+        self.classes: List[ast.ClassDef] = []
+        self.units: List[Unit] = []
+        self._collect()
+
+    def _collect(self) -> None:
+        for node in module_level_stmts(self.tree.body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.append(
+                    FunctionInfo(self, node.name, node.name, None, node))
+            elif isinstance(node, ast.ClassDef):
+                self.classes.append(node)
+                for sub in module_level_stmts(node.body):
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.functions.append(FunctionInfo(
+                            self, sub.name, f"{node.name}.{sub.name}",
+                            node.name, sub))
+        for fi in self.functions:
+            self._collect_units(fi.node, fi.qualname, None)
+
+    def _collect_units(self, node, qualprefix: str, parent: Optional[Unit]):
+        name = getattr(node, "name", None)
+        qual = qualprefix if parent is None else f"{qualprefix}.{name or '<lambda>'}"
+        unit = Unit(self, name, qual, node, parent, node.lineno)
+        self.units.append(unit)
+        body = node.body if not isinstance(node, ast.Lambda) else [node.body]
+
+        def scan(n):
+            if isinstance(n, ast.Call):
+                cn = call_name(n)
+                if cn == "pallas_call":
+                    unit.has_pallas_call = True
+                if cn == "pallas_guarded":
+                    unit.calls_pallas_guarded = True
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    self._collect_units(child, qual, unit)
+                else:
+                    scan(child)
+
+        for stmt in body:
+            scan(stmt)
+
+    # -- suppression / classification helpers ----------------------------
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A finding is suppressed by ``# graftlint: ok(<rule>)`` on its own
+        line, the line above, or on/above the ``def`` line of an enclosing
+        function (which scopes the suppression to the whole function)."""
+        for ln in (line, line - 1):
+            rules = self.suppressions.get(ln)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        for u in self.units:
+            end = getattr(u.node, "end_lineno", u.lineno)
+            if not (u.lineno <= line <= end):
+                continue
+            for ln in (u.lineno, u.lineno - 1):
+                rules = self.suppressions.get(ln)
+                if rules and (rule in rules or "all" in rules):
+                    return True
+        return False
+
+    def internal_alias(self, name: str) -> bool:
+        """True when ``name`` is an import alias of a module in this repo
+        (anything under the repo's own top-level packages)."""
+        target = self.import_aliases.get(name)
+        if target is None:
+            return False
+        root = target.split(".")[0]
+        return root in ("distributed_faiss_tpu", "tools") or target.startswith(".")
+
+    def is_ops(self) -> bool:
+        return "/ops/" in self.relpath or self.relpath.startswith("ops/")
+
+
+class RepoModel:
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = modules
+        self.functions: List[FunctionInfo] = [
+            f for m in modules for f in m.functions
+        ]
+        self.units: List[Unit] = [u for m in modules for u in m.units]
+        self.by_name: Dict[str, List[FunctionInfo]] = defaultdict(list)
+        for f in self.functions:
+            self.by_name[f.name].append(f)
+        self.jitted_names: Set[str] = {f.name for f in self.functions if f.jit}
+        self._mark_hot()
+
+    def _mark_hot(self) -> None:
+        roots = [f for f in self.functions if f.hot_annotated]
+        for suffix, qualname in HOT_ROOTS:
+            roots += [
+                f for f in self.functions
+                if f.qualname == qualname and f.module.relpath.endswith(suffix)
+            ]
+        seen: Set[int] = set()
+        stack = list(roots)
+        while stack:
+            f = stack.pop()
+            if id(f) in seen:
+                continue
+            seen.add(id(f))
+            f.hot = True
+            for name in f.called_names:
+                if name in HOT_EDGE_STOPLIST:
+                    continue
+                for g in self.by_name.get(name, ()):
+                    if id(g) not in seen:
+                        stack.append(g)
+
+
+def collect_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in sorted(dirnames)
+                if not d.startswith(".") and d != "__pycache__"
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def build_model(paths: Iterable[str]) -> RepoModel:
+    modules = []
+    for path in collect_files(paths):
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        modules.append(ModuleInfo(path, os.path.relpath(path), source))
+    return RepoModel(modules)
+
+
+def lint(model: RepoModel) -> List[Finding]:
+    from tools.graftlint import checks
+
+    findings: List[Finding] = []
+    by_path = {m.relpath: m for m in model.modules}
+    for checker in checks.ALL:
+        for f in checker.check(model):
+            mod = by_path.get(f.path)
+            if mod is not None and mod.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(paths: Iterable[str]) -> List[Finding]:
+    return lint(build_model(paths))
