@@ -29,6 +29,8 @@ _LAZY = {
     "Index": ("distributed_faiss_tpu.engine", "Index"),
     "IndexServer": ("distributed_faiss_tpu.parallel.server", "IndexServer"),
     "IndexClient": ("distributed_faiss_tpu.parallel.client", "IndexClient"),
+    "MultiRankError": ("distributed_faiss_tpu.parallel.client", "MultiRankError"),
+    "RetryPolicy": ("distributed_faiss_tpu.parallel.rpc", "RetryPolicy"),
 }
 
 __all__ = list(_LAZY)

@@ -31,9 +31,14 @@ from typing import List, Optional, Tuple, Union
 import numpy as np
 
 from distributed_faiss_tpu.models.factory import build_index, index_from_state_dict
+from distributed_faiss_tpu.utils import serialization
 from distributed_faiss_tpu.utils.batching import SearchBatcher
 from distributed_faiss_tpu.utils.config import IndexCfg
-from distributed_faiss_tpu.utils.serialization import load_state, save_state
+from distributed_faiss_tpu.utils.serialization import (
+    atomic_write,
+    load_state,
+    save_state,
+)
 from distributed_faiss_tpu.utils.state import IndexState
 
 logger = logging.getLogger()
@@ -111,7 +116,10 @@ class _MetaStore:
 
 
 def get_index_files(index_storage_dir: str) -> Tuple[str, str, str, str]:
-    """File layout per shard (reference: index.py:103-108, .faiss -> .npz)."""
+    """LEGACY flat file layout per shard (reference: index.py:103-108,
+    .faiss -> .npz). Saves now write generation-suffixed sets committed by
+    a MANIFEST (see utils/serialization.py); these names remain only so
+    pre-manifest checkpoints still load."""
     index_file = os.path.join(index_storage_dir, "index.npz")
     meta_file = os.path.join(index_storage_dir, "meta.pkl")
     buffer_file = os.path.join(index_storage_dir, "buffer.pkl")
@@ -144,6 +152,9 @@ class Index:
 
         self.index_save_time = time.time()
         self.index_saved_size = 0
+        # newest committed snapshot generation in this shard's storage dir
+        # (0 = nothing committed yet; from_storage_dir seeds it on restore)
+        self._generation = 0
 
         # concurrent searches coalesce into shared device launches
         # (launch-bound serving — utils/batching.py); window 0 = natural
@@ -468,42 +479,132 @@ class Index:
                 return False
             storage_dir = self.cfg.index_storage_dir
             os.makedirs(storage_dir, exist_ok=True)
-            index_file, meta_file, buffer_file, cfg_file = get_index_files(storage_dir)
 
-            # atomic writes: tmp file + rename so a crash mid-save never
-            # leaves a torn checkpoint (conscious fix of the reference's
-            # acknowledged TODO at index.py:443-446)
-            def _atomic(path, write_fn, mode):
-                tmp = path + ".tmp"
-                with open(tmp, mode) as f:
-                    write_fn(f)
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, path)
-
-            # rename order matters across the SET: meta, buffer and cfg all
-            # land before the index, so at any crash point the files that
-            # describe an index are never older than the index itself —
-            # load invariant len(meta) >= index.ntotal holds (worst case:
-            # newer meta/cfg with an older index -> from_storage_dir
-            # truncates meta gracefully, cfg knobs apply to the older index)
-            _atomic(meta_file, lambda f: pickle.dump(self.id_to_metadata.tolist(), f), "wb")
-            _atomic(buffer_file, lambda f: pickle.dump(self.embeddings_buffer, f), "wb")
-            _atomic(cfg_file, lambda f: f.write(self.cfg.to_json_string() + "\n"), "w")
-            _atomic(index_file, lambda f: save_state(f, self.tpu_index.state_dict()), "wb")
+            # torn-snapshot-proof save: every file of this save carries a
+            # fresh generation number (atomic tmp+fsync+rename each), and
+            # the generation only becomes loadable when its MANIFEST — with
+            # per-file sha256 — lands LAST. kill -9 at any byte offset
+            # leaves either the previous committed generation intact or a
+            # complete new one; load verifies checksums and quarantines
+            # anything in between (supersedes the reference's acknowledged
+            # torn-write TODO, index.py:443-446)
+            # seed the generation number from BOTH the in-memory counter
+            # and the newest generation on disk: a
+            # fresh engine over a dir with existing generations (rank
+            # restarted without --load-index, or create_index on a rejoined
+            # rank) must not recycle a low number — prune_generations would
+            # immediately delete the snapshot it just committed and loads
+            # would roll back to the stale newest-on-disk generation
+            disk_gens = serialization.list_generations(storage_dir)
+            gen = max(self._generation, disk_gens[0][0] if disk_gens else 0) + 1
+            plan = {
+                "index": ("npz", "wb",
+                          lambda f: save_state(f, self.tpu_index.state_dict())),
+                "meta": ("pkl", "wb",
+                         lambda f: pickle.dump(self.id_to_metadata.tolist(), f)),
+                "buffer": ("pkl", "wb",
+                           lambda f: pickle.dump(self.embeddings_buffer, f)),
+                "cfg": ("json", "w",
+                        lambda f: f.write(self.cfg.to_json_string() + "\n")),
+            }
+            entries = {}
+            for key, (ext, mode, write_fn) in plan.items():
+                name = serialization.generation_filename(key, gen, ext)
+                digest = atomic_write(os.path.join(storage_dir, name), write_fn, mode)
+                entries[key] = {"name": name, "sha256": digest}
+            serialization.write_manifest(
+                storage_dir, gen, entries,
+                extra={"ntotal": int(self.tpu_index.ntotal)},
+            )
+            # unversioned cfg.json convenience copy: get_config_path readers
+            # (IndexClient.load_index) expect it at a fixed name; it is NOT
+            # part of the committed set
+            atomic_write(
+                os.path.join(storage_dir, "cfg.json"),
+                lambda f: f.write(self.cfg.to_json_string() + "\n"), "w",
+            )
+            self._generation = gen
+            serialization.prune_generations(storage_dir, keep=2)
 
             self.index_saved_size = self.tpu_index.ntotal
             self.index_save_time = time.time()
-            logger.info("saved index (%d vectors) to %s", self.index_saved_size, storage_dir)
+            logger.info("saved index (%d vectors) to %s as generation %d",
+                        self.index_saved_size, storage_dir, gen)
             return True
 
     @classmethod
     def from_storage_dir(
         cls, index_storage_dir: str, cfg: IndexCfg = None, ignore_buffer: bool = True
     ) -> Union[None, "Index"]:
-        """Restore a shard (reference: index.py:284-344). Returns None when no
-        index file exists; re-adds a consistent leftover buffer, else truncates
-        metadata to index size."""
+        """Restore a shard (reference: index.py:284-344). Returns None when
+        nothing loadable exists; re-adds a consistent leftover buffer, else
+        truncates metadata to index size.
+
+        Generations are tried NEWEST first: a manifest whose files fail the
+        sha256 check (torn save — crash or disk corruption) is quarantined
+        (renamed under ``quarantine/``, never deleted) and the previous
+        complete generation loads instead, so a rank killed at any byte
+        offset of a save still comes back with its last committed snapshot.
+        Pre-manifest flat checkpoints (index.npz + meta.pkl) load through
+        the legacy path.
+        """
+        stale = serialization.quarantine_stale_tmps(index_storage_dir)
+        if stale:
+            logger.warning("quarantined %d abandoned .tmp file(s): %s",
+                           len(stale), stale)
+        chosen = None
+        for gen, mpath in serialization.list_generations(index_storage_dir):
+            try:
+                manifest = serialization.load_manifest(mpath)
+                errors = serialization.verify_manifest(index_storage_dir, manifest)
+            except (OSError, ValueError) as e:
+                errors = [f"unreadable manifest: {e}"]
+            if not errors:
+                chosen = (gen, manifest)
+                break
+            reason = "; ".join(errors)
+            logger.warning(
+                "generation %d at %s is torn (%s): quarantining and falling "
+                "back to the previous generation", gen, index_storage_dir, reason,
+            )
+            serialization.quarantine_generation(index_storage_dir, gen, reason)
+
+        if chosen is None:
+            return cls._from_legacy_layout(index_storage_dir, cfg, ignore_buffer)
+
+        gen, manifest = chosen
+        # data files newer than the chosen generation have no manifest (the
+        # save died before its commit point): incomplete by construction
+        orphans = serialization.quarantine_orphans(index_storage_dir, newer_than=gen)
+        if orphans:
+            logger.warning("quarantined %d uncommitted newer file(s): %s",
+                           len(orphans), orphans)
+
+        def gen_path(key):
+            return os.path.join(index_storage_dir, manifest["files"][key]["name"])
+
+        tpu_index = index_from_state_dict(load_state(gen_path("index")))
+        with open(gen_path("meta"), "rb") as f:
+            meta = pickle.load(f)
+        assert len(meta) >= tpu_index.ntotal, (
+            "Deserialized meta list should be at least of index size"
+        )
+        buffer = []
+        if not ignore_buffer:
+            with open(gen_path("buffer"), "rb") as f:
+                buffer = pickle.load(f)
+        if cfg is None:
+            cfg = IndexCfg.from_json(gen_path("cfg"))
+        result = cls._restore(cfg, tpu_index, meta, buffer)
+        result._generation = gen
+        return result
+
+    @classmethod
+    def _from_legacy_layout(
+        cls, index_storage_dir: str, cfg: IndexCfg, ignore_buffer: bool
+    ) -> Union[None, "Index"]:
+        """Pre-manifest checkpoints: flat index.npz/meta.pkl/buffer.pkl
+        written in rename order (meta/buffer/cfg before index)."""
         index_file, meta_file, buffer_file, cfg_file = get_index_files(index_storage_dir)
         if not os.path.exists(index_file):
             logger.info("no index found at %s", index_file)
@@ -526,7 +627,13 @@ class Index:
 
         if cfg is None:
             cfg = IndexCfg.from_json(cfg_file) if os.path.isfile(cfg_file) else IndexCfg()
+        return cls._restore(cfg, tpu_index, meta, buffer)
 
+    @classmethod
+    def _restore(cls, cfg: IndexCfg, tpu_index, meta: list, buffer: list) -> "Index":
+        """Shared restore tail: wire a loaded (index, meta, buffer) triple
+        into a TRAINED engine, re-adding a consistent leftover buffer and
+        truncating metadata otherwise."""
         result = cls(cfg)
         result.tpu_index = tpu_index
         result.state = IndexState.TRAINED

@@ -7,6 +7,15 @@ fan-out search with client-side top-k merge (negated-dot semantics), filtered
 search with 3x over-fetch, cluster state aggregation, and broadcast ops
 (save/load/drop/ntotal/ids/centroids/nprobe).
 
+Beyond the reference (which has no failure handling past startup backoff,
+SURVEY §5.3), the WRITE path self-heals: per-rank RPCs retry transport
+failures under a ``rpc.RetryPolicy`` (exponential backoff + jitter),
+``add_index_data`` reroutes a failed batch to the next live rank in
+round-robin order (recording the skip in ``self.reroutes`` — an
+acknowledged batch is never lost), and broadcast ops retry per rank and
+raise a structured ``MultiRankError`` carrying every rank's outcome
+instead of dying on the first exception.
+
 The merge replaces the reference's FAISS C++ ``float_maxheap_array_t``
 (ResultHeap, client.py:29-54) with a numpy concat + argpartition top-k —
 same semantics (min-merge over per-server blocks, dot scores negated before
@@ -59,10 +68,44 @@ class _FailedRank:
         self.stub, self.error = stub, error
 
 
+class MultiRankError(RuntimeError):
+    """A broadcast op failed on one or more ranks.
+
+    Carries the full per-rank picture instead of the first exception that
+    happened to surface from the pool: ``outcomes`` has one dict per rank —
+    ``{"server", "host", "port", "ok", "result"|"error", "exception"}`` —
+    so callers can tell a single dead rank (retry/skip it) from a cluster-
+    wide misconfiguration (every rank rejected the op), and operators see
+    every failing rank in one message rather than re-running once per rank.
+    """
+
+    def __init__(self, op: str, outcomes: List[dict]):
+        self.op = op
+        self.outcomes = outcomes
+        failed = [o for o in outcomes if not o["ok"]]
+        detail = "; ".join(
+            f"rank {o['server']} ({o['host']}:{o['port']}): {o['error']}"
+            for o in failed
+        )
+        super().__init__(
+            f"{op} failed on {len(failed)}/{len(outcomes)} ranks: {detail}"
+        )
+
+    @property
+    def failures(self) -> List[dict]:
+        return [o for o in self.outcomes if not o["ok"]]
+
+    @property
+    def results(self) -> List[object]:
+        """Results from the ranks that DID succeed (partial completion)."""
+        return [o["result"] for o in self.outcomes if o["ok"]]
+
+
 class IndexClient:
     """Handle to a cluster of index servers (one shard each)."""
 
-    def __init__(self, server_list_path: str, cfg_path: Optional[str] = None):
+    def __init__(self, server_list_path: str, cfg_path: Optional[str] = None,
+                 retry_policy: Optional[rpc.RetryPolicy] = None):
         machine_ports = IndexClient.read_server_list(server_list_path)
         self.sub_indexes = IndexClient.setup_connection(machine_ports)
         self.num_indexes = len(self.sub_indexes)
@@ -74,7 +117,15 @@ class IndexClient:
 
         self.pool = ThreadPool(self.num_indexes)
         self.cur_server_ids = {}
-        random.seed(time.time())
+        # private RNG for round-robin start placement: the reference's
+        # random.seed(time.time()) stomps the GLOBAL RNG state of the host
+        # process (breaking reproducibility for any suite constructing a
+        # client)
+        self._rng = random.Random()
+        self.retry = retry_policy if retry_policy is not None else rpc.RetryPolicy()
+        # one entry per batch that had to skip a dead rank:
+        # {index_id, skipped_server, host, port, error, rerouted_to}
+        self.reroutes: List[dict] = []
         self.cfg = IndexCfg.from_json(cfg_path) if cfg_path is not None else None
 
     # ------------------------------------------------------------ discovery
@@ -88,28 +139,37 @@ class IndexClient:
     ) -> List[Tuple[str, int]]:
         """Parse ``count\\nhost,port\\n...`` discovery files, waiting with
         exponential backoff until the advertised server count has registered
-        (reference client.py:87-120)."""
+        (reference client.py:87-120). A not-yet-created (or still-empty)
+        file counts as "0 of N registered" and keeps waiting — the launcher
+        writes the header AFTER a client may have started — instead of
+        raising FileNotFoundError before the backoff loop even begins."""
         time_waited = 0.0
         while True:
             num_servers = None
             res = []
-            with open(server_list_path) as f:
-                for idx, line in enumerate(f):
-                    line = line.strip()
-                    if not line:
-                        continue
-                    if idx == 0:
-                        num_servers = int(line)
-                    else:
-                        host, port = line.split(",")[:2]
-                        res.append((host.strip(), int(port)))
-            if num_servers is None:
-                raise RuntimeError(f"empty server list {server_list_path}")
-            if num_servers == len(res):
-                return res
-            msg = (
-                f"{num_servers} != {len(res)} in server list {server_list_path}."
-            )
+            try:
+                with open(server_list_path) as f:
+                    for idx, line in enumerate(f):
+                        line = line.strip()
+                        if not line:
+                            continue
+                        if idx == 0:
+                            num_servers = int(line)
+                        else:
+                            host, port = line.split(",")[:2]
+                            res.append((host.strip(), int(port)))
+            except FileNotFoundError:
+                msg = f"server list {server_list_path} not created yet."
+            else:
+                if num_servers is not None and num_servers == len(res):
+                    return res
+                if num_servers is None:
+                    msg = f"server list {server_list_path} is empty."
+                else:
+                    msg = (
+                        f"{num_servers} != {len(res)} in server list "
+                        f"{server_list_path}."
+                    )
             if time_waited + initial_timeout >= total_max_timeout:
                 raise RuntimeError(
                     msg + f" Timed out after waiting {round(time_waited, 2)} seconds"
@@ -125,6 +185,47 @@ class IndexClient:
             rpc.Client(i, host, port) for i, (host, port) in enumerate(machine_ports)
         ]
 
+    # ------------------------------------------------------- fault-tolerant fan-out
+
+    def _call_with_retry(self, stub, fname: str, args=(), kwargs=None):
+        """One rank's RPC under the retry policy (transport failures only —
+        an application error from a live rank propagates immediately)."""
+        return self.retry.run(stub.generic_fun, fname, args, kwargs)
+
+    def _broadcast(self, fname: str, args=(), kwargs=None) -> list:
+        """Fan ``fname`` out to every rank with per-rank retry.
+
+        Unlike the reference (whose pool.map dies on the FIRST rank error,
+        leaving the op's fate on the other ranks unknown), every rank runs
+        to an outcome; any failure then raises ``MultiRankError`` carrying
+        all of them, and full success returns the per-rank results in stub
+        order.
+        """
+
+        def one(stub):
+            try:
+                return True, self._call_with_retry(stub, fname, args, kwargs)
+            except Exception as e:
+                logger.warning(
+                    "broadcast %s failed on rank %s (%s:%s): %s",
+                    fname, stub.id, stub.host, stub.port, e,
+                )
+                return False, e
+
+        raw = self.pool.map(one, self.sub_indexes)
+        outcomes = []
+        for stub, (ok, val) in zip(self.sub_indexes, raw):
+            o = {"server": stub.id, "host": stub.host, "port": stub.port, "ok": ok}
+            if ok:
+                o["result"] = val
+            else:
+                o["error"] = f"{type(val).__name__}: {val}"
+                o["exception"] = val
+            outcomes.append(o)
+        if not all(o["ok"] for o in outcomes):
+            raise MultiRankError(fname, outcomes)
+        return [o["result"] for o in outcomes]
+
     # ------------------------------------------------------------ lifecycle
 
     def create_index(self, index_id: str, cfg: Optional[IndexCfg] = None):
@@ -132,15 +233,13 @@ class IndexClient:
             self.cfg = cfg
         if self.cfg is None:
             self.cfg = IndexCfg()
-        return self.pool.map(
-            lambda idx: idx.create_index(index_id, self.cfg), self.sub_indexes
-        )
+        return self._broadcast("create_index", (index_id, self.cfg))
 
     def drop_index(self, index_id: str):
-        self.pool.map(lambda idx: idx.drop_index(index_id), self.sub_indexes)
+        self._broadcast("drop_index", (index_id,))
 
     def save_index(self, index_id: str):
-        self.pool.map(lambda idx: idx.save_index(index_id), self.sub_indexes)
+        self._broadcast("save_index", (index_id,))
 
     def load_index(
         self,
@@ -149,14 +248,10 @@ class IndexClient:
         force_reload: bool = True,
     ) -> bool:
         if force_reload:
-            self.pool.map(lambda idx: idx.drop_index(index_id), self.sub_indexes)
-        all_loaded = self.pool.map(
-            lambda idx: idx.load_index(index_id, cfg), self.sub_indexes
-        )
+            self._broadcast("drop_index", (index_id,))
+        all_loaded = self._broadcast("load_index", (index_id, cfg))
         if cfg is None:
-            config_paths = self.pool.map(
-                lambda idx: idx.get_config_path(index_id), self.sub_indexes
-            )
+            config_paths = self._broadcast("get_config_path", (index_id,))
             if config_paths and os.path.isfile(config_paths[0]):
                 cfg = IndexCfg.from_json(config_paths[0])
             else:
@@ -179,25 +274,64 @@ class IndexClient:
         train_async_if_triggered: bool = True,
     ) -> None:
         """Round-robin batch placement: first target random, then cyclic
-        (reference client.py:174-192) — each call lands on ONE server."""
+        (reference client.py:174-192) — each call lands on ONE server.
+
+        Self-healing (the reference aborts ingest outright on one dead
+        rank): the placed rank's RPC retries transport failures under the
+        retry policy; if the rank stays dead the batch REROUTES to the next
+        live rank in round-robin order, the skip is recorded in
+        ``self.reroutes``, and round-robin resumes after the rank that
+        actually acknowledged. Returning without an exception means some
+        rank acked the batch — an acknowledged batch is never lost. Only
+        when EVERY rank refuses the batch does the call raise. Note the
+        at-least-once caveat: a retry whose first attempt's ack (not the
+        request) was lost can duplicate rows — unique metadata ids make
+        that detectable downstream.
+        """
         if index_id not in self.cur_server_ids:
-            self.cur_server_ids[index_id] = random.randint(0, self.num_indexes - 1)
+            self.cur_server_ids[index_id] = self._rng.randint(0, self.num_indexes - 1)
         sid = self.cur_server_ids[index_id]
-        self.sub_indexes[sid].add_index_data(
-            index_id, embeddings, metadata, train_async_if_triggered
-        )
-        self.cur_server_ids[index_id] = (sid + 1) % self.num_indexes
+        last_exc = None
+        for offset in range(self.num_indexes):
+            target = (sid + offset) % self.num_indexes
+            stub = self.sub_indexes[target]
+            try:
+                self._call_with_retry(
+                    stub, "add_index_data",
+                    (index_id, embeddings, metadata, train_async_if_triggered),
+                )
+            except rpc.TRANSPORT_ERRORS as e:
+                logger.warning(
+                    "add_index_data: rank %s (%s:%s) unreachable after "
+                    "retries, rerouting batch to next rank: %s",
+                    stub.id, stub.host, stub.port, e,
+                )
+                self.reroutes.append({
+                    "index_id": index_id,
+                    "skipped_server": stub.id,
+                    "host": stub.host,
+                    "port": stub.port,
+                    "error": f"{type(e).__name__}: {e}",
+                    "rerouted_to": (target + 1) % self.num_indexes,
+                })
+                last_exc = e
+                continue
+            self.cur_server_ids[index_id] = (target + 1) % self.num_indexes
+            return
+        raise RuntimeError(
+            f"add_index_data for {index_id!r} failed on every rank"
+        ) from last_exc
 
     def sync_train(self, index_id: str) -> None:
-        self.pool.map(lambda idx: idx.sync_train(index_id), self.sub_indexes)
+        self._broadcast("sync_train", (index_id,))
 
     def async_train(self, index_id: str) -> None:
         # the reference's async_train also fans out sync_train
         # (client.py:197-198); we dispatch the server-side async path
-        self.pool.map(lambda idx: idx.async_train(index_id), self.sub_indexes)
+        self._broadcast("async_train", (index_id,))
 
     def add_buffer_to_index(self, index_id: str):
-        self.pool.map(lambda idx: idx.add_buffer_to_index(index_id), self.sub_indexes)
+        self._broadcast("add_buffer_to_index", (index_id,))
 
     # ------------------------------------------------------------ query
 
@@ -389,40 +523,53 @@ class IndexClient:
     # ------------------------------------------------------------ observability
 
     def get_state(self, index_id: str) -> IndexState:
-        states = self.pool.map(lambda idx: idx.get_state(index_id), self.sub_indexes)
+        states = self.pool.map(
+            lambda idx: self._call_with_retry(idx, "get_state", (index_id,)),
+            self.sub_indexes,
+        )
         return IndexState.get_aggregated_states(states)
 
     def get_ntotal(self, index_id: str) -> int:
-        return sum(self.pool.map(lambda idx: idx.get_ntotal(index_id), self.sub_indexes))
+        return sum(self.pool.map(
+            lambda idx: self._call_with_retry(idx, "get_ntotal", (index_id,)),
+            self.sub_indexes,
+        ))
 
     def get_buffer_depth(self, index_id: str) -> int:
         """Cluster-wide count of buffered-but-unindexed vectors (sums the
         per-rank get_aggregated_ntotal RPC — the reference exposes it only
         per-server, server.py:268-272). Zero + TRAINED == fully indexed."""
         return sum(self.pool.map(
-            lambda idx: idx.get_aggregated_ntotal(index_id), self.sub_indexes
+            lambda idx: self._call_with_retry(
+                idx, "get_aggregated_ntotal", (index_id,)),
+            self.sub_indexes,
         ))
 
     def get_ids(self, index_id: str) -> set:
-        id_sets = self.pool.map(lambda idx: idx.get_ids(index_id), self.sub_indexes)
+        id_sets = self.pool.map(
+            lambda idx: self._call_with_retry(idx, "get_ids", (index_id,)),
+            self.sub_indexes,
+        )
         return set().union(*id_sets)
 
     def get_centroids(self, index_id: str):
-        return self.pool.map(lambda idx: idx.get_centroids(index_id), self.sub_indexes)
+        return self.pool.map(
+            lambda idx: self._call_with_retry(idx, "get_centroids", (index_id,)),
+            self.sub_indexes,
+        )
 
     def set_nprobe(self, index_id: str, nprobe: int):
-        return self.pool.map(
-            lambda idx: idx.set_nprobe(index_id, nprobe), self.sub_indexes
-        )
+        return self._broadcast("set_nprobe", (index_id, nprobe))
 
     def set_omp_num_threads(self, num_threads: int) -> None:
-        self.pool.map(
-            lambda idx: idx.set_omp_num_threads(num_threads), self.sub_indexes
-        )
+        self._broadcast("set_omp_num_threads", (num_threads,))
 
     def get_perf_stats(self) -> list:
         """Per-server RPC latency summaries (observability, SURVEY §5.1)."""
-        return self.pool.map(lambda idx: idx.get_perf_stats(), self.sub_indexes)
+        return self.pool.map(
+            lambda idx: self._call_with_retry(idx, "get_perf_stats"),
+            self.sub_indexes,
+        )
 
     def ping(self, timeout: float = 10.0) -> list:
         """Health-check every server; returns per-server dicts or the error

@@ -23,6 +23,7 @@ Frame layout (little-endian):
 import io
 import os
 import pickle
+import random
 import socket
 import struct
 import threading
@@ -31,6 +32,10 @@ import time
 import numpy as np
 
 DEFAULT_PORT = 12032  # same default port as the reference (rpc.py:22)
+
+# jitter draws come from a private generator: retry timing must never
+# perturb the host process's global RNG stream (test reproducibility)
+_jitter_rng = random.Random()
 
 # ---------------------------------------------------------------- unpickling
 #
@@ -113,6 +118,74 @@ class ClientExit(Exception):
 
 class ServerException(Exception):
     """A remote exception, carrying the server-side traceback text."""
+
+
+class FrameError(RuntimeError):
+    """The byte stream violated the frame protocol (bad magic): corruption
+    or desync. The connection that produced it must never be reused."""
+
+
+# exception classes that mean "the bytes never made it intact / the peer is
+# gone", i.e. the rank may be dead, restarting, or behind a corrupting
+# link. FrameError and UnpicklingError are here because a garbled RESPONSE
+# surfaces client-side as one of them — generic_fun has already dropped the
+# connection, so a retry redials cleanly (no less safe than the lost-ack
+# case the at-least-once design accepts). ServerException is deliberately
+# NOT here: it means the rank is alive and rejected the request (retrying
+# an application error just repeats it, and masking it would hide a
+# misconfigured shard).
+TRANSPORT_ERRORS = (OSError, EOFError, FrameError, pickle.UnpicklingError)
+
+
+class RetryPolicy:
+    """Bounded exponential backoff with jitter, for TRANSPORT errors only.
+
+    The write path wraps per-rank RPCs in ``run``: a call that fails with a
+    transport error (rank dead, connection reset, deadline expired) is
+    re-attempted up to ``max_attempts`` times, sleeping
+    ``base_delay * multiplier**attempt`` (capped at ``max_delay``) between
+    attempts, with +/- ``jitter`` fractional randomization so a fleet of
+    retrying clients doesn't stampede a restarting rank in lockstep.
+    Application errors (ServerException and anything else non-transport)
+    propagate immediately — they are deterministic and retrying them only
+    hides the real failure.
+    """
+
+    transport_errors = TRANSPORT_ERRORS
+
+    def __init__(self, max_attempts: int = 3, base_delay: float = 0.05,
+                 multiplier: float = 2.0, max_delay: float = 2.0,
+                 jitter: float = 0.5):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError("jitter must be in [0, 1]")
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.multiplier = multiplier
+        self.max_delay = max_delay
+        self.jitter = jitter
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        return isinstance(exc, self.transport_errors)
+
+    def delay(self, attempt: int) -> float:
+        """Sleep before retry number ``attempt`` (0-based: the delay between
+        the first failure and the second attempt is ``delay(0)``)."""
+        d = min(self.max_delay, self.base_delay * (self.multiplier ** attempt))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * _jitter_rng.random() - 1.0)
+        return max(0.0, d)
+
+    def run(self, fn, *args, **kwargs):
+        """Call ``fn(*args, **kwargs)``, retrying transport failures."""
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args, **kwargs)
+            except self.transport_errors:
+                if attempt + 1 >= self.max_attempts:
+                    raise
+                time.sleep(self.delay(attempt))
 
 
 class _TensorRef:
@@ -200,7 +273,7 @@ def recv_frame(sock: socket.socket):
     head = _recv_exact(sock, _HDR.size)
     magic, kind, skel_len, narr = _HDR.unpack(head)
     if magic != MAGIC:
-        raise RuntimeError(f"bad frame magic {bytes(magic)!r}")
+        raise FrameError(f"bad frame magic {bytes(magic)!r}")
     skel = restricted_loads(_recv_exact(sock, skel_len))
     arrays = []
     for _ in range(narr):
@@ -306,7 +379,7 @@ class Client:
                 kind, payload = recv_frame(self.sock)
             except Exception:
                 # OSError/EOFError (socket timeouts, mid-frame stream ends)
-                # but also RuntimeError("bad frame magic") and unpickling
+                # but also FrameError ("bad frame magic") and unpickling
                 # failures (ADVICE r4): any mid-frame failure leaves the
                 # stream position unknown, so the connection must never be
                 # reused — drop it and let the NEXT call redial cleanly

@@ -310,6 +310,18 @@ class IndexServer:
                     except (rpc.ClientExit, EOFError, OSError):
                         sel.unregister(conn)
                         conn.close()
+                    except Exception as e:
+                        # malformed frame / undecodable payload (bad magic,
+                        # UnpicklingError): drop this connection only — the
+                        # loop keeps serving everyone else, matching the
+                        # threaded mode's behavior in _serve_connection
+                        logger.warning(
+                            "dropping connection from %s: %s", key.data, e)
+                        sel.unregister(conn)
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
         sel.close()
 
     # ------------------------------------------------------------ internals

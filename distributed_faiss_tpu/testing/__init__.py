@@ -1,0 +1,14 @@
+"""Fault-injection harnesses for exercising the failure paths in CI.
+
+Per "The Tail at Scale" (Dean & Barroso, 2013), fault tolerance that is
+not continuously exercised regresses: this package is the oracle the
+robustness layer is tested against — a scriptable TCP chaos proxy
+(``chaos.ChaosProxy``) and a process-level rank kill/restart harness
+(``chaos.ServerHarness``). Test-support code, but shipped inside the
+package so operators can drive game-day drills against staging clusters
+with the same tooling CI uses.
+"""
+
+from distributed_faiss_tpu.testing.chaos import ChaosProxy, Fault, ServerHarness
+
+__all__ = ["ChaosProxy", "Fault", "ServerHarness"]

@@ -1,16 +1,35 @@
-"""Shard persistence: index state_dicts as npz (arrays) + json header.
+"""Shard persistence: index state_dicts as npz (arrays) + json header,
+plus the torn-snapshot-proof manifest layer.
 
 Our own serialization format replacing ``faiss.write_index/read_index``
 (reference: distributed_faiss/index.py:460,297). Numeric arrays go in an
 npz (no pickle needed for tensor data); scalars/strings ride in a json
 header stored as a uint8 array inside the same file.
+
+Manifest layer (the reference has none — its checkpoints tear on crash,
+index.py:443-446): every save is a numbered GENERATION of suffixed files
+(``index-g00000007.npz``, ``meta-g00000007.pkl``, ...) committed by a
+``MANIFEST-g00000007.json`` carrying each file's sha256, written LAST via
+atomic tmp+fsync+rename. The manifest IS the commit point: a crash at any
+byte offset of a save leaves either a complete committed generation or
+uncommitted garbage that loading quarantines (renames into
+``quarantine/`` — never deletes) before falling back to the previous
+complete generation.
 """
 
+import hashlib
 import json
+import os
+import re
+import time
 
 import numpy as np
 
 _META_KEY = "__meta__"
+
+MANIFEST_RE = re.compile(r"^MANIFEST-g(\d{8})\.json$")
+GENFILE_RE = re.compile(r"^[a-z]+-g(\d{8})\.[a-z]+$")
+QUARANTINE_DIR = "quarantine"
 
 
 def save_state(path_or_file, state: dict) -> None:
@@ -36,3 +55,170 @@ def load_state(path: str) -> dict:
         state = {k: z[k] for k in z.files if k != _META_KEY}
         state.update(json.loads(bytes(z[_META_KEY].tobytes()).decode("utf-8")))
     return state
+
+
+# --------------------------------------------------------------- atomic writes
+
+
+def atomic_write(path: str, write_fn, mode: str) -> str:
+    """tmp + fsync + rename write; returns the sha256 hex digest of the
+    bytes that landed (hashed from the tmp file, i.e. exactly what the
+    rename publishes)."""
+    tmp = path + ".tmp"
+    with open(tmp, mode) as f:
+        write_fn(f)
+        f.flush()
+        os.fsync(f.fileno())
+    digest = sha256_file(tmp)
+    os.replace(tmp, path)
+    return digest
+
+
+def sha256_file(path: str, chunk_bytes: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk_bytes)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+# ------------------------------------------------------------------- manifests
+
+
+def generation_filename(key: str, gen: int, ext: str) -> str:
+    return f"{key}-g{gen:08d}.{ext}"
+
+
+def manifest_path(storage_dir: str, gen: int) -> str:
+    return os.path.join(storage_dir, f"MANIFEST-g{gen:08d}.json")
+
+
+def write_manifest(storage_dir: str, gen: int, files: dict, extra=None) -> str:
+    """Commit a generation: atomically write its manifest. ``files`` maps a
+    logical key ("index", "meta", ...) to {"name": <basename>, "sha256":
+    <hex>}. Must be called only after every listed file is durably in
+    place — this write is the generation's commit point."""
+    manifest = {
+        "generation": gen,
+        "created": time.time(),
+        "files": files,
+    }
+    if extra:
+        manifest.update(extra)
+    path = manifest_path(storage_dir, gen)
+    atomic_write(path, lambda f: f.write(json.dumps(manifest, indent=1) + "\n"), "w")
+    return path
+
+
+def load_manifest(path: str) -> dict:
+    with open(path) as f:
+        manifest = json.load(f)
+    if "generation" not in manifest or "files" not in manifest:
+        raise ValueError(f"manifest {path} missing required keys")
+    return manifest
+
+
+def verify_manifest(storage_dir: str, manifest: dict) -> list:
+    """Check every file the manifest lists exists with a matching sha256.
+    Returns a list of human-readable problems (empty == complete set)."""
+    errors = []
+    for key, entry in manifest["files"].items():
+        path = os.path.join(storage_dir, entry["name"])
+        if not os.path.exists(path):
+            errors.append(f"{key}: {entry['name']} missing")
+            continue
+        digest = sha256_file(path)
+        if digest != entry["sha256"]:
+            errors.append(
+                f"{key}: {entry['name']} sha256 mismatch "
+                f"(want {entry['sha256'][:12]}.., got {digest[:12]}..)"
+            )
+    return errors
+
+
+def list_generations(storage_dir: str) -> list:
+    """[(gen, manifest_path)] for every committed generation, NEWEST first."""
+    if not os.path.isdir(storage_dir):
+        return []
+    found = []
+    for name in os.listdir(storage_dir):
+        m = MANIFEST_RE.match(name)
+        if m:
+            found.append((int(m.group(1)), os.path.join(storage_dir, name)))
+    return sorted(found, reverse=True)
+
+
+def _quarantine_file(storage_dir: str, name: str) -> None:
+    qdir = os.path.join(storage_dir, QUARANTINE_DIR)
+    os.makedirs(qdir, exist_ok=True)
+    dst = os.path.join(qdir, name)
+    if os.path.exists(dst):  # re-quarantine of a recycled generation number
+        dst = f"{dst}.{time.monotonic_ns()}"
+    os.replace(os.path.join(storage_dir, name), dst)
+
+
+def quarantine_generation(storage_dir: str, gen: int, reason: str = "") -> list:
+    """Move every file of generation ``gen`` (data + manifest) into
+    ``quarantine/``. Renames, never deletes — a torn set is evidence, and
+    an operator may still salvage rows from it. Returns moved basenames."""
+    tag = f"g{gen:08d}"
+    moved = []
+    for name in sorted(os.listdir(storage_dir)):
+        m = MANIFEST_RE.match(name) or GENFILE_RE.match(name)
+        if m and int(m.group(1)) == gen:
+            _quarantine_file(storage_dir, name)
+            moved.append(name)
+    if moved:
+        note = os.path.join(storage_dir, QUARANTINE_DIR, f"{tag}.reason.txt")
+        # the note is advisory; never let it fail the load path
+        try:
+            with open(note, "a") as f:
+                f.write(f"{time.time():.0f} {reason or 'torn generation'}\n")
+        except OSError:
+            pass
+    return moved
+
+
+def quarantine_orphans(storage_dir: str, newer_than: int) -> list:
+    """Quarantine generation-suffixed data files NEWER than the newest
+    committed generation (a crash between data writes and the manifest
+    leaves these; their set is incomplete by construction)."""
+    moved = []
+    for name in sorted(os.listdir(storage_dir)):
+        m = GENFILE_RE.match(name)
+        if m and int(m.group(1)) > newer_than:
+            _quarantine_file(storage_dir, name)
+            moved.append(name)
+    return moved
+
+
+def quarantine_stale_tmps(storage_dir: str) -> list:
+    """Quarantine ``*.tmp`` leftovers of atomic_write (a writer killed
+    between open and rename). Only valid at LOAD time — by contract no
+    writer is active then, so any .tmp is abandoned; without this sweep a
+    full-index-sized file per crash accumulates forever (GENFILE_RE never
+    matches the double extension)."""
+    if not os.path.isdir(storage_dir):
+        return []
+    moved = []
+    for name in sorted(os.listdir(storage_dir)):
+        if name.endswith(".tmp") and os.path.isfile(os.path.join(storage_dir, name)):
+            _quarantine_file(storage_dir, name)
+            moved.append(name)
+    return moved
+
+
+def prune_generations(storage_dir: str, keep: int = 2) -> None:
+    """Delete COMMITTED generations beyond the newest ``keep`` (these were
+    fully verified at commit; quarantine is only for torn sets). The
+    fallback generation always survives: keep >= 2."""
+    gens = list_generations(storage_dir)
+    for gen, mpath in gens[keep:]:
+        for name in sorted(os.listdir(storage_dir)):
+            m = GENFILE_RE.match(name)
+            if m and int(m.group(1)) == gen:
+                os.unlink(os.path.join(storage_dir, name))
+        os.unlink(mpath)

@@ -1,6 +1,11 @@
 """Client unit tests: discovery parsing + result aggregation golden
 (model: reference tests/test_client.py:19-39 and
-tests/test_integration.py:181-203)."""
+tests/test_integration.py:181-203), plus discovery-file robustness and
+global-RNG hygiene."""
+
+import random
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -25,6 +30,66 @@ def test_read_server_list_timeout(tmp_path):
     with pytest.raises(RuntimeError) as ei:
         IndexClient.read_server_list(path, total_max_timeout=0)
     assert "4 != 3" in str(ei.value)
+
+
+def test_read_server_list_waits_for_missing_file(tmp_path):
+    """The launcher creates the discovery file AFTER a client may have
+    started: a missing file must enter the registration backoff loop (as
+    '0 of N registered'), not raise FileNotFoundError immediately."""
+    path = str(tmp_path / "late.txt")
+
+    def create_late():
+        time.sleep(0.4)
+        write_list(tmp_path, 2, [("a", 1), ("b", 2)], name="late.txt")
+
+    t = threading.Thread(target=create_late)
+    t.start()
+    got = IndexClient.read_server_list(path, total_max_timeout=30)
+    t.join()
+    assert got == [("a", 1), ("b", 2)]
+
+
+def test_read_server_list_missing_file_times_out(tmp_path):
+    path = str(tmp_path / "never.txt")
+    with pytest.raises(RuntimeError, match="not created"):
+        IndexClient.read_server_list(path, total_max_timeout=0)
+
+
+def test_read_server_list_empty_file_waits_then_times_out(tmp_path):
+    # an empty-but-existing file is a header mid-write, not a fatal state
+    path = tmp_path / "empty.txt"
+    path.write_text("")
+    with pytest.raises(RuntimeError, match="empty"):
+        IndexClient.read_server_list(str(path), total_max_timeout=0)
+
+
+def test_client_ctor_does_not_stomp_global_rng(tmp_path):
+    """The reference's random.seed(time.time()) in IndexClient.__init__
+    resets the GLOBAL RNG of the host process, breaking reproducibility
+    for any suite constructing a client; placement must use a private
+    random.Random instance."""
+    import socket
+
+    from distributed_faiss_tpu.parallel.server import IndexServer
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    srv = IndexServer(0, str(tmp_path))
+    threading.Thread(target=srv.start_blocking, args=(port,), daemon=True).start()
+    path = write_list(tmp_path, 1, [("localhost", port)])
+
+    random.seed(1234)
+    state_before = random.getstate()
+    client = IndexClient(path)
+    assert random.getstate() == state_before, (
+        "IndexClient.__init__ mutated the global random state"
+    )
+    # placement still works off the private generator
+    assert 0 <= client._rng.randint(0, client.num_indexes - 1) < 1
+    client.close()
+    srv.stop()
 
 
 def test_merge_result_blocks():

@@ -114,34 +114,36 @@ def test_save_load_round_trip(rng, tmp_path):
 
 
 def test_save_rename_order(rng, tmp_path, monkeypatch):
-    """Pin the checkpoint crash-point invariant: every file describing the
-    index (meta, buffer, cfg) must be renamed into place BEFORE the index
-    itself, so a crash at any point never leaves a new index with stale
-    metadata or stale cfg knobs."""
+    """Pin the checkpoint crash-point invariant: every data file of a
+    snapshot generation must be renamed into place BEFORE that generation's
+    MANIFEST — the manifest is the commit point, so a crash at any rename
+    leaves either the previous committed generation or an uncommitted
+    (quarantinable) partial set, never a loadable torn one."""
     import os as _os
 
     order = []
     real_replace = _os.replace
 
-    checkpoint_files = {"index.npz", "meta.pkl", "buffer.pkl", "cfg.json"}
-
     def spy(src, dst):
-        # the spy patches the process-global os module: record only the
-        # checkpoint renames, not unrelated library activity
-        if _os.path.basename(dst) in checkpoint_files:
+        # every checkpoint rename goes through serialization.atomic_write;
+        # record only this shard's files, not unrelated library activity
+        if str(tmp_path) in str(dst):
             order.append(_os.path.basename(dst))
         return real_replace(src, dst)
 
-    monkeypatch.setattr("distributed_faiss_tpu.engine.os.replace", spy)
+    monkeypatch.setattr(
+        "distributed_faiss_tpu.utils.serialization.os.replace", spy)
     storage = str(tmp_path / "ord")
     idx = Index(flat_cfg(train_num=10, index_storage_dir=storage))
     idx.add_batch(rng.standard_normal((20, 16)).astype(np.float32), None,
                   train_async_if_triggered=False)
     assert wait_state(idx, IndexState.TRAINED)
     assert idx.save()
-    assert order.index("index.npz") == len(order) - 1, order
-    for first in ("meta.pkl", "buffer.pkl", "cfg.json"):
-        assert order.index(first) < order.index("index.npz"), order
+    manifest = "MANIFEST-g00000001.json"
+    assert manifest in order, order
+    for data in ("index-g00000001.npz", "meta-g00000001.pkl",
+                 "buffer-g00000001.pkl", "cfg-g00000001.json"):
+        assert order.index(data) < order.index(manifest), order
 
 
 def test_load_missing_returns_none(tmp_path):

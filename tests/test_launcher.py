@@ -180,12 +180,17 @@ def test_degraded_mode_search_with_dead_rank(tmp_path):
              "--storage-dir", storage],
             env={**os.environ, **env},
         ))
+        from distributed_faiss_tpu.parallel.client import MultiRankError
+
         t0 = time.time()
         while True:
             try:
                 assert client.load_index("pidx", cfg, force_reload=False)
                 break
-            except OSError:
+            except (OSError, MultiRankError):
+                # broadcast ops now aggregate per-rank failures into a
+                # structured MultiRankError instead of leaking the first
+                # OSError out of the pool
                 assert time.time() - t0 < 60, "restarted rank never came up"
                 time.sleep(0.3)
         scores3, metas3, missing3 = client.search(

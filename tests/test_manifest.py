@@ -1,0 +1,335 @@
+"""Torn-snapshot-proof persistence: manifest write/verify helpers, engine
+generation saves, checksum-verified loads with fallback to the previous
+complete generation, quarantine (rename-never-delete), and a deterministic
+corruption sweep standing in for kill -9 at every byte offset (the
+real-SIGKILL loop lives in tests/test_chaos.py)."""
+
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.engine import Index
+from distributed_faiss_tpu.utils import serialization
+from distributed_faiss_tpu.utils.config import IndexCfg
+from distributed_faiss_tpu.utils.state import IndexState
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def flat_cfg(**kw):
+    kw.setdefault("index_builder_type", "flat")
+    kw.setdefault("dim", 16)
+    kw.setdefault("metric", "l2")
+    return IndexCfg(**kw)
+
+
+def wait_state(idx, state, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if idx.get_state() == state:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def build_saved(tmp_path, rng, rows=60, saves=1):
+    storage = str(tmp_path / "shard")
+    idx = Index(flat_cfg(train_num=20, index_storage_dir=storage))
+    x = rng.standard_normal((rows, 16)).astype(np.float32)
+    idx.add_batch(x[:rows // 2], [("m", i) for i in range(rows // 2)],
+                  train_async_if_triggered=False)
+    assert wait_state(idx, IndexState.TRAINED)
+    assert idx.save() is True
+    for s in range(1, saves):
+        lo = rows // 2 + (s - 1) * (rows // (2 * max(1, saves - 1)))
+        hi = min(rows, lo + rows // (2 * max(1, saves - 1)))
+        idx.add_batch(x[lo:hi], [("m", i) for i in range(lo, hi)],
+                      train_async_if_triggered=False)
+        deadline = time.time() + 60
+        while idx.get_idx_data_num()[0] > 0:
+            assert time.time() < deadline
+            time.sleep(0.02)
+        assert wait_state(idx, IndexState.TRAINED)
+        assert idx.save() is True
+    return storage, idx, x
+
+
+# ----------------------------------------------------- serialization helpers
+
+
+def test_atomic_write_returns_digest_of_published_bytes(tmp_path):
+    p = str(tmp_path / "f.bin")
+    digest = serialization.atomic_write(p, lambda f: f.write(b"payload"), "wb")
+    assert digest == serialization.sha256_file(p)
+    assert not os.path.exists(p + ".tmp")
+
+
+def test_manifest_round_trip_and_verify(tmp_path):
+    d = str(tmp_path)
+    name = serialization.generation_filename("index", 3, "npz")
+    assert name == "index-g00000003.npz"
+    digest = serialization.atomic_write(
+        os.path.join(d, name), lambda f: f.write(b"abc"), "wb")
+    mpath = serialization.write_manifest(
+        d, 3, {"index": {"name": name, "sha256": digest}}, extra={"ntotal": 9})
+    manifest = serialization.load_manifest(mpath)
+    assert manifest["generation"] == 3 and manifest["ntotal"] == 9
+    assert serialization.verify_manifest(d, manifest) == []
+    # flip one byte -> verify names the file and the mismatch
+    with open(os.path.join(d, name), "r+b") as f:
+        f.write(b"x")
+    errors = serialization.verify_manifest(d, manifest)
+    assert len(errors) == 1 and "sha256 mismatch" in errors[0]
+    os.unlink(os.path.join(d, name))
+    assert "missing" in serialization.verify_manifest(d, manifest)[0]
+
+
+def test_list_generations_newest_first(tmp_path):
+    d = str(tmp_path)
+    for g in (1, 3, 2):
+        serialization.write_manifest(d, g, {})
+    assert [g for g, _ in serialization.list_generations(d)] == [3, 2, 1]
+    assert serialization.list_generations(str(tmp_path / "nope")) == []
+
+
+# ------------------------------------------------------------- engine saves
+
+
+def test_save_writes_generation_and_manifest(tmp_path, rng):
+    storage, idx, _ = build_saved(tmp_path, rng)
+    gens = serialization.list_generations(storage)
+    assert [g for g, _ in gens] == [1]
+    manifest = serialization.load_manifest(gens[0][1])
+    assert serialization.verify_manifest(storage, manifest) == []
+    assert set(manifest["files"]) == {"index", "meta", "buffer", "cfg"}
+    assert manifest["ntotal"] == idx.tpu_index.ntotal
+    # unversioned cfg.json convenience copy for get_config_path readers
+    assert os.path.isfile(os.path.join(storage, "cfg.json"))
+
+
+def test_repeated_saves_prune_to_two_generations(tmp_path, rng):
+    storage, idx, _ = build_saved(tmp_path, rng, rows=90, saves=3)
+    gens = serialization.list_generations(storage)
+    assert [g for g, _ in gens] == [3, 2]  # keep=2: newest + fallback
+    # pruned generation-1 files are GONE (they were committed, not torn:
+    # deletion, not quarantine)
+    assert not any("g00000001" in n for n in os.listdir(storage))
+    assert not os.path.isdir(os.path.join(storage, "quarantine"))
+    loaded = Index.from_storage_dir(storage)
+    assert loaded.tpu_index.ntotal == idx.tpu_index.ntotal
+    assert loaded._generation == 3
+
+
+def test_load_round_trip_newest_generation(tmp_path, rng):
+    storage, idx, x = build_saved(tmp_path, rng, rows=80, saves=2)
+    loaded = Index.from_storage_dir(storage)
+    assert loaded is not None and loaded.get_state() == IndexState.TRAINED
+    s0, m0, _ = idx.search(x[:3], 4)
+    s1, m1, _ = loaded.search(x[:3], 4)
+    np.testing.assert_allclose(s0, s1, rtol=1e-5)
+    assert m0 == m1
+
+
+# ------------------------------------------- fallback + quarantine semantics
+
+
+def corrupt(path, offset=None):
+    size = os.path.getsize(path)
+    offset = size // 2 if offset is None else min(offset, size - 1)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def newest_files(storage):
+    gens = serialization.list_generations(storage)
+    manifest = serialization.load_manifest(gens[0][1])
+    return gens[0][0], gens[0][1], manifest
+
+
+def test_corrupt_newest_falls_back_and_quarantines(tmp_path, rng):
+    storage, idx, x = build_saved(tmp_path, rng, rows=80, saves=2)
+    gen, mpath, manifest = newest_files(storage)
+    assert gen == 2
+    victim = manifest["files"]["index"]["name"]
+    corrupt(os.path.join(storage, victim))
+
+    loaded = Index.from_storage_dir(storage)
+    assert loaded is not None, "fallback generation must load"
+    assert loaded._generation == 1
+    scores, meta, _ = loaded.search(x[:2], 3)
+    assert all(m is not None for row in meta for m in row)
+    # the torn set moved to quarantine/ — renamed, never deleted
+    qdir = os.path.join(storage, "quarantine")
+    quarantined = os.listdir(qdir)
+    assert victim in quarantined
+    assert os.path.basename(mpath) in quarantined
+    assert not os.path.exists(os.path.join(storage, victim))
+
+
+def test_missing_file_in_newest_falls_back(tmp_path, rng):
+    storage, _, _ = build_saved(tmp_path, rng, rows=80, saves=2)
+    _, _, manifest = newest_files(storage)
+    os.unlink(os.path.join(storage, manifest["files"]["meta"]["name"]))
+    loaded = Index.from_storage_dir(storage)
+    assert loaded is not None and loaded._generation == 1
+
+
+def test_unreadable_manifest_falls_back(tmp_path, rng):
+    storage, _, _ = build_saved(tmp_path, rng, rows=80, saves=2)
+    _, mpath, _ = newest_files(storage)
+    with open(mpath, "w") as f:
+        f.write('{"generation": 2, "files"')  # torn json
+    loaded = Index.from_storage_dir(storage)
+    assert loaded is not None and loaded._generation == 1
+
+
+def test_both_generations_torn_returns_none(tmp_path, rng):
+    storage, _, _ = build_saved(tmp_path, rng, rows=80, saves=2)
+    for _, mpath in serialization.list_generations(storage):
+        manifest = serialization.load_manifest(mpath)
+        corrupt(os.path.join(storage, manifest["files"]["index"]["name"]))
+    assert Index.from_storage_dir(storage) is None
+    # nothing deleted: every generation is in quarantine for forensics
+    qdir = os.path.join(storage, "quarantine")
+    assert len([n for n in os.listdir(qdir) if "index-" in n]) == 2
+
+
+def test_uncommitted_newer_files_are_quarantined_not_loaded(tmp_path, rng):
+    """A crash between data writes and the manifest leaves generation-N+1
+    data files with no manifest: load must serve generation N and sweep the
+    orphans aside."""
+    storage, idx, _ = build_saved(tmp_path, rng)
+    orphan = serialization.generation_filename("index", 2, "npz")
+    with open(os.path.join(storage, orphan), "wb") as f:
+        f.write(b"partial write before crash")
+    loaded = Index.from_storage_dir(storage)
+    assert loaded is not None and loaded._generation == 1
+    assert os.path.exists(os.path.join(storage, "quarantine", orphan))
+    assert not os.path.exists(os.path.join(storage, orphan))
+
+
+def test_save_after_fallback_recycles_generation_number(tmp_path, rng):
+    """After loading the fallback (gen 1 of 2), the next save commits a
+    fresh generation 2 even though a quarantined gen 2 existed."""
+    storage, _, x = build_saved(tmp_path, rng, rows=80, saves=2)
+    _, _, manifest = newest_files(storage)
+    corrupt(os.path.join(storage, manifest["files"]["index"]["name"]))
+    loaded = Index.from_storage_dir(storage)
+    assert loaded._generation == 1
+    loaded.add_batch(x[:10], [("n", i) for i in range(10)],
+                     train_async_if_triggered=False)
+    deadline = time.time() + 60
+    while loaded.get_idx_data_num()[0] > 0:
+        assert time.time() < deadline
+        time.sleep(0.02)
+    assert wait_state(loaded, IndexState.TRAINED)
+    assert loaded.save() is True
+    gen, _, manifest2 = newest_files(storage)
+    assert gen == 2
+    assert serialization.verify_manifest(storage, manifest2) == []
+    again = Index.from_storage_dir(storage)
+    assert again._generation == 2
+
+
+def test_fresh_engine_over_existing_generations_numbers_past_disk(tmp_path, rng):
+    """A fresh engine whose storage dir already holds generations (rank
+    restarted without load, create_index on a rejoined rank) must number
+    its first save PAST the newest on disk — recycling a low number would
+    let prune delete the snapshot it just committed and loads would roll
+    back to the stale newest-on-disk generation."""
+    storage, _, x = build_saved(tmp_path, rng, rows=90, saves=3)
+    assert [g for g, _ in serialization.list_generations(storage)] == [3, 2]
+
+    fresh = Index(flat_cfg(train_num=20, index_storage_dir=storage))
+    assert fresh._generation == 0  # never loaded: in-memory counter is cold
+    fresh.add_batch(x[:30], [("f", i) for i in range(30)],
+                    train_async_if_triggered=False)
+    assert wait_state(fresh, IndexState.TRAINED)
+    assert fresh.save() is True
+
+    gens = serialization.list_generations(storage)
+    assert [g for g, _ in gens] == [4, 3]  # committed past disk, then pruned
+    manifest = serialization.load_manifest(gens[0][1])
+    assert serialization.verify_manifest(storage, manifest) == []
+    loaded = Index.from_storage_dir(storage)
+    assert loaded._generation == 4
+    assert loaded.tpu_index.ntotal == 30  # the fresh snapshot, not stale data
+
+
+def test_stale_tmp_files_swept_to_quarantine(tmp_path, rng):
+    """atomic_write leftovers (writer killed between open and rename) are
+    quarantined at load — without the sweep a full-index-sized .tmp per
+    crash accumulates forever."""
+    storage, _, _ = build_saved(tmp_path, rng)
+    for tmp_name in ("index-g00000002.npz.tmp", "cfg.json.tmp"):
+        with open(os.path.join(storage, tmp_name), "wb") as f:
+            f.write(b"abandoned mid-write")
+    loaded = Index.from_storage_dir(storage)
+    assert loaded is not None and loaded._generation == 1
+    qdir = os.path.join(storage, "quarantine")
+    assert set(os.listdir(qdir)) >= {"index-g00000002.npz.tmp", "cfg.json.tmp"}
+    assert not any(n.endswith(".tmp") for n in os.listdir(storage))
+
+
+def test_legacy_flat_layout_still_loads(tmp_path, rng):
+    """Pre-manifest checkpoints (flat index.npz/meta.pkl/cfg.json) must
+    keep loading through the legacy path."""
+    storage, idx, x = build_saved(tmp_path, rng)
+    gens = serialization.list_generations(storage)
+    manifest = serialization.load_manifest(gens[0][1])
+    # rewrite the generation as the old flat layout
+    legacy = {"index": "index.npz", "meta": "meta.pkl",
+              "buffer": "buffer.pkl", "cfg": "cfg.json"}
+    for key, flat in legacy.items():
+        src = os.path.join(storage, manifest["files"][key]["name"])
+        os.replace(src, os.path.join(storage, flat))
+    os.unlink(gens[0][1])
+
+    loaded = Index.from_storage_dir(storage)
+    assert loaded is not None and loaded.get_state() == IndexState.TRAINED
+    assert loaded._generation == 0  # legacy load: next save commits gen 1
+    s0, m0, _ = idx.search(x[:2], 3)
+    s1, m1, _ = loaded.search(x[:2], 3)
+    np.testing.assert_allclose(s0, s1, rtol=1e-5)
+    assert m0 == m1
+
+
+def test_corruption_sweep_never_loads_torn_set(tmp_path, rng):
+    """Deterministic stand-in for kill -9 at any byte offset of a save:
+    corrupt the newest generation's index file at a sweep of offsets (and
+    truncate at several lengths); EVERY variant must load the previous
+    complete generation — never a torn set, never an exception."""
+    storage, idx, x = build_saved(tmp_path, rng, rows=80, saves=2)
+    _, _, manifest = newest_files(storage)
+    victim_rel = manifest["files"]["index"]["name"]
+    pristine = str(tmp_path / "pristine")
+    shutil.copytree(storage, pristine)
+    size = os.path.getsize(os.path.join(storage, victim_rel))
+
+    offsets = sorted({0, 1, size // 4, size // 2, 3 * size // 4, size - 1})
+    for off in offsets:
+        work = str(tmp_path / f"sweep-{off}")
+        shutil.copytree(pristine, work)
+        corrupt(os.path.join(work, victim_rel), offset=off)
+        loaded = Index.from_storage_dir(work)
+        assert loaded is not None, f"offset {off}: fallback must load"
+        assert loaded._generation == 1, f"offset {off} served a torn set"
+        shutil.rmtree(work)
+    for trunc in (0, 1, size // 2, size - 1):
+        work = str(tmp_path / f"trunc-{trunc}")
+        shutil.copytree(pristine, work)
+        with open(os.path.join(work, victim_rel), "r+b") as f:
+            f.truncate(trunc)
+        loaded = Index.from_storage_dir(work)
+        assert loaded is not None and loaded._generation == 1, (
+            f"truncation at {trunc} bytes served a torn set")
+        shutil.rmtree(work)

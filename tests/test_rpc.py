@@ -140,13 +140,18 @@ def test_remote_exception(echo_endpoint):
     c.close()
 
 
-def test_malformed_frame_kills_only_that_connection(tmp_path):
-    """Garbage bytes on the wire must drop that connection, not the server."""
+@pytest.mark.parametrize("mode", ["blocking", "selector"])
+def test_malformed_frame_kills_only_that_connection(tmp_path, mode):
+    """Garbage bytes on the wire must drop that connection, not the server —
+    in BOTH serving modes (the selector loop used to die on the first
+    malformed frame: RuntimeError('bad frame magic') escaped its
+    per-connection except clause and killed the whole serving loop)."""
     from distributed_faiss_tpu.parallel.server import IndexServer
 
     port = free_port()
     srv = IndexServer(0, str(tmp_path))
-    threading.Thread(target=srv.start_blocking, args=(port,), daemon=True).start()
+    target = srv.start_blocking if mode == "blocking" else srv.start
+    threading.Thread(target=target, args=(port,), daemon=True).start()
     deadline = time.time() + 10
     probe = None
     while time.time() < deadline:

@@ -34,6 +34,13 @@ PINS = {
     ("Index", "total_data"): "buffer_lock",
     ("Index", "id_to_metadata"): "buffer_lock",
     ("IndexServer", "indexes"): "indexes_lock",
+    # chaos harness thread state (testing/chaos.py): the live-socket list
+    # is appended by per-connection handler threads and drained by stop();
+    # the fault plan cursor and default fault are read/advanced per accept
+    ("ChaosProxy", "_conns"): "_lock",
+    ("ChaosProxy", "_accepted"): "_lock",
+    ("ChaosProxy", "_default_fault"): "_lock",
+    ("ServerHarness", "procs"): "_lock",
 }
 
 _SKIP_METHODS = frozenset({"__init__", "__new__", "__del__"})
