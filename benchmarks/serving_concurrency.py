@@ -1,8 +1,8 @@
 #!/usr/bin/env python3
 """Multi-client serving throughput: dynamic batching vs per-call launches.
 
-Measures aggregate QPS of T concurrent client threads, each issuing
-B-query searches against one engine Index, three ways:
+Measures aggregate QPS (and per-request p99 latency) of T concurrent
+client threads, each issuing B-query searches against one engine Index:
 
   percall  — each caller drives its own device launch (the reference's
              serving model: one launch per RPC under index_lock)
@@ -11,13 +11,26 @@ B-query searches against one engine Index, three ways:
   window   — SearchBatcher with a small wait window (leader waits
              window_ms for followers before launching)
 
-On a launch-bound backend (the TPU relay: ~66 ms/dispatch —
-benchmarks/profile_ivf.py) natural/window batching multiplies multi-
-client QPS; on CPU the dispatch floor is tiny so the three converge.
+plus the serving-scheduler A/B (``--scheduler``, default both arms):
 
-Prints one JSON line per mode.
+  scheduler_off — the per-call reference serving shape (same path as
+                  percall: one padded device batch per request)
+  scheduler_on  — requests flow through serving.SearchScheduler (bounded
+                  queue + batcher thread, 2 ms flush window), the path
+                  the RPC serving loops use
+
+The scheduler arms also cross-check RESULT IDENTITY: every client's
+scheduler-on (scores, ids) must be byte-identical to its scheduler-off
+results (the batch a row rides in must not change its answer).
+
+On a launch-bound backend (the TPU relay: ~66 ms/dispatch —
+benchmarks/profile_ivf.py) batching multiplies multi-client QPS; on CPU
+the dispatch floor is tiny so the gap narrows.
+
+Prints one JSON line per mode/arm (qps, p99_ms) for the trajectory file.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -30,26 +43,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_mode(idx, mode, queries, n_threads, reps, k=10):
-    """Aggregate QPS of n_threads concurrent callers."""
-    from distributed_faiss_tpu.utils.batching import SearchBatcher
-
-    if mode == "percall":
-        search = idx._device_search
-    elif mode == "natural":
-        search = SearchBatcher(idx._device_search, window_ms=0).search
-    else:
-        search = SearchBatcher(idx._device_search, window_ms=3).search
-
+def run_clients(search, queries, n_threads, reps, k=10):
+    """Drive n_threads concurrent callers of ``search(q, k)``; returns
+    (aggregate qps, p99 per-request latency in ms)."""
     barrier = threading.Barrier(n_threads + 1)
     errs = []
+    lats = [[] for _ in range(n_threads)]
 
     def client(tid):
         q = queries[tid]
         barrier.wait()
         try:
             for _ in range(reps):
+                t0 = time.perf_counter()
                 search(q, k)
+                lats[tid].append(time.perf_counter() - t0)
         except Exception as e:  # pragma: no cover
             errs.append(e)
 
@@ -63,10 +71,91 @@ def run_mode(idx, mode, queries, n_threads, reps, k=10):
     dt = time.time() - t0
     assert not errs, errs[:1]
     total = n_threads * reps * queries[0].shape[0]
-    return total / dt
+    all_lats = np.array([x for row in lats for x in row])
+    return total / dt, float(np.percentile(all_lats, 99) * 1000.0)
+
+
+def make_search(idx, mode):
+    from distributed_faiss_tpu.utils.batching import SearchBatcher
+
+    if mode == "percall":
+        return idx._device_search
+    if mode == "natural":
+        return SearchBatcher(idx._device_search, window_ms=0).search
+    if mode == "window":
+        return SearchBatcher(idx._device_search, window_ms=3).search
+    raise ValueError(mode)
+
+
+def scheduler_arms(idx, arm):
+    """(name, search(q, k)) pairs for the requested --scheduler arm(s)."""
+    from distributed_faiss_tpu.serving import SearchScheduler
+    from distributed_faiss_tpu.utils.config import SchedulerCfg
+
+    arms = []
+    if arm in ("off", "both"):
+        # the reference serving shape: one padded launch per request
+        arms.append(("scheduler_off", idx._device_search))
+    if arm in ("on", "both"):
+        sched = SearchScheduler(
+            lambda _iid, q, k, _re: idx._device_search(q, k),
+            SchedulerCfg(max_wait_ms=2.0, max_batch_rows=1024, max_queue=512),
+            name="bench-batcher",
+        )
+        arms.append(("scheduler_on",
+                     lambda q, k: sched.submit("bench", q, k)))
+    return arms
+
+
+def check_identity(idx, arms, queries, k, reps=3):
+    """Every client's results must match the direct per-call launch exactly
+    — with the arm driven CONCURRENTLY, so the scheduler arm's rows really
+    ride merged batches (a sequential probe would submit one request per
+    flush and never reach the concat/split path this check exists for)."""
+    golden = [idx._device_search(q, k) for q in queries]
+    identical = {}
+    for name, search in arms:
+        res = [[] for _ in queries]
+        errs = []
+        barrier = threading.Barrier(len(queries))
+
+        def client(t, search=search, res=res, barrier=barrier, errs=errs):
+            barrier.wait()
+            try:
+                for _ in range(reps):
+                    res[t].append(search(queries[t], k))
+            except Exception as e:  # a silent dead thread would leave
+                errs.append(e)      # res[t] empty and the check vacuous
+
+        ts = [threading.Thread(target=client, args=(t,))
+              for t in range(len(queries))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, (name, errs[:1])
+        arm_ok = True
+        for t, (g_scores, g_ids) in enumerate(golden):
+            assert len(res[t]) == reps, (name, t, len(res[t]))
+            for scores, ids in res[t]:
+                if not (np.array_equal(scores, g_scores)
+                        and np.array_equal(ids, g_ids)):
+                    arm_ok = False
+        identical[name] = arm_ok  # per arm: a scheduler divergence must
+    return identical              # not stamp the direct-launch row false
 
 
 def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--scheduler", choices=("on", "off", "both", "none"), default="both",
+        help="serving-scheduler A/B arm(s) to run (default: both, with a "
+             "result-identity cross-check)")
+    parser.add_argument(
+        "--modes", default="percall,natural,window",
+        help="comma list of legacy batcher modes to run ('' = skip)")
+    args = parser.parse_args()
+
     import jax
 
     from distributed_faiss_tpu.engine import Index
@@ -101,12 +190,28 @@ def main():
     idx.search(queries[0], k)  # warm the jit cache
 
     backend = jax.devices()[0].platform
-    for mode in ("percall", "natural", "window"):
-        qps = run_mode(idx, mode, queries, n_threads, reps, k)
+    modes = [m for m in args.modes.split(",") if m]
+    for mode in modes:
+        qps, p99 = run_clients(make_search(idx, mode), queries,
+                               n_threads, reps, k)
         print(json.dumps({
             "case": f"concurrency_{mode}", "backend": backend,
             "threads": n_threads, "batch": batch, "qps": round(qps, 1),
+            "p99_ms": round(p99, 2),
         }), flush=True)
+
+    if args.scheduler != "none":
+        arms = scheduler_arms(idx, args.scheduler)
+        identical = check_identity(idx, arms, queries, k)
+        for name, search in arms:
+            qps, p99 = run_clients(search, queries, n_threads, reps, k)
+            print(json.dumps({
+                "case": name, "backend": backend, "threads": n_threads,
+                "batch": batch, "qps": round(qps, 1),
+                "p99_ms": round(p99, 2), "identical": identical[name],
+            }), flush=True)
+        assert all(identical.values()), \
+            f"results diverged from direct launches: {identical}"
 
 
 if __name__ == "__main__":

@@ -31,6 +31,10 @@ _LAZY = {
     "IndexClient": ("distributed_faiss_tpu.parallel.client", "IndexClient"),
     "MultiRankError": ("distributed_faiss_tpu.parallel.client", "MultiRankError"),
     "RetryPolicy": ("distributed_faiss_tpu.parallel.rpc", "RetryPolicy"),
+    "BusyError": ("distributed_faiss_tpu.parallel.rpc", "BusyError"),
+    "DeadlineExceeded": ("distributed_faiss_tpu.parallel.rpc", "DeadlineExceeded"),
+    "SchedulerCfg": ("distributed_faiss_tpu.utils.config", "SchedulerCfg"),
+    "SearchScheduler": ("distributed_faiss_tpu.serving.scheduler", "SearchScheduler"),
 }
 
 __all__ = list(_LAZY)

@@ -370,30 +370,57 @@ class Index:
         self, query_batch: np.ndarray, top_k: int = 100, return_embeddings: bool = False
     ) -> Tuple[np.ndarray, List[List[object]], Optional[List[List[np.ndarray]]]]:
         query_batch = np.asarray(query_batch, np.float32)
-        embs = None
         if not return_embeddings:
             # hot path: concurrent callers share device launches (state
             # re-checked under the lock inside _device_search)
             scores, indexes = self._batcher.search(query_batch, top_k)
+            embs_arr = None
         else:
-            # embeddings must be reconstructed from the SAME index state
-            # that produced the ids, so this path stays atomic under
-            # index_lock instead of riding the batcher
-            with self.index_lock:
-                if self.state != IndexState.TRAINED:
-                    raise RuntimeError(
-                        f"Server index is not trained. state: {self.state}")
-                scores, indexes = self.tpu_index.search(query_batch, top_k)
-                flat = indexes.reshape(-1)
-                if self.tpu_index.ntotal == 0:
-                    # trained-but-empty window: all ids are -1
-                    rec = np.zeros((flat.shape[0], query_batch.shape[1]), np.float32)
-                else:
-                    safe = np.where(flat >= 0, flat, 0)
-                    rec = np.array(self.tpu_index.reconstruct_batch(safe))
-                    rec[flat < 0] = 0.0
-                embs_arr = rec.reshape(indexes.shape + (query_batch.shape[1],))
+            scores, indexes, embs_arr = self._search_reconstruct(
+                query_batch, top_k)
+        return self._join_results(scores, indexes, embs_arr, return_embeddings)
 
+    def search_batched(
+        self, query_batch: np.ndarray, top_k: int = 100, return_embeddings: bool = False
+    ) -> Tuple[np.ndarray, List[List[object]], Optional[List[List[np.ndarray]]]]:
+        """The already-batched search entry for the serving scheduler
+        (serving/scheduler.py): identical results to ``search`` — same
+        locked device launch, same metadata join — but WITHOUT the
+        in-process SearchBatcher in front. The scheduler has already
+        coalesced concurrent callers into ``query_batch``, and it calls
+        from a single batcher thread, so routing through the natural
+        batcher again would only add leader/follower bookkeeping to every
+        launch."""
+        query_batch = np.asarray(query_batch, np.float32)
+        if not return_embeddings:
+            scores, indexes = self._device_search(query_batch, top_k)
+            embs_arr = None
+        else:
+            scores, indexes, embs_arr = self._search_reconstruct(
+                query_batch, top_k)
+        return self._join_results(scores, indexes, embs_arr, return_embeddings)
+
+    def _search_reconstruct(self, query_batch: np.ndarray, top_k: int):
+        """Search + embedding reconstruction. Embeddings must come from the
+        SAME index state that produced the ids, so this path stays atomic
+        under index_lock instead of riding any batcher."""
+        with self.index_lock:
+            if self.state != IndexState.TRAINED:
+                raise RuntimeError(
+                    f"Server index is not trained. state: {self.state}")
+            scores, indexes = self.tpu_index.search(query_batch, top_k)
+            flat = indexes.reshape(-1)
+            if self.tpu_index.ntotal == 0:
+                # trained-but-empty window: all ids are -1
+                rec = np.zeros((flat.shape[0], query_batch.shape[1]), np.float32)
+            else:
+                safe = np.where(flat >= 0, flat, 0)
+                rec = np.array(self.tpu_index.reconstruct_batch(safe))
+                rec[flat < 0] = 0.0
+            embs_arr = rec.reshape(indexes.shape + (query_batch.shape[1],))
+        return scores, indexes, embs_arr
+
+    def _join_results(self, scores, indexes, embs_arr, return_embeddings):
         # vectorized metadata join: lock held only for the snapshot; safe
         # outside the lock because the store is append-only past the
         # snapshotted length (see _MetaStore docstring)
@@ -413,8 +440,9 @@ class Index:
         joined = meta_arr.take(safe.ravel()).reshape(indexes.shape)
         joined[~valid] = None
         results_meta = joined.tolist()
-        nq, k = indexes.shape
+        embs = None
         if return_embeddings:
+            nq, k = indexes.shape
             embs = [[embs_arr[i, j] for j in range(k)] for i in range(nq)]
         return scores, results_meta, embs
 

@@ -343,6 +343,7 @@ class IndexClient:
         return_embeddings: bool = False,
         allow_partial: bool = False,
         partial_timeout: Optional[float] = None,
+        deadline: Optional[float] = None,
     ) -> tuple:  # (D, meta[, embs][, missing]) — see docstring
         """Fan-out search with client-side top-k merge.
 
@@ -363,6 +364,16 @@ class IndexClient:
         same stub redials automatically (rpc.Client auto-reconnect with a
         short budget + cooldown) — a restarted rank rejoins this client's
         fan-out without rebuilding the IndexClient.
+
+        ``deadline`` (seconds of budget for this call) rides every
+        per-rank RPC frame so an overloaded rank's scheduler can shed the
+        request before it touches the device; an expired budget raises
+        ``rpc.DeadlineExceeded``. BUSY rejections (scheduler queue full)
+        are retried under the client's RetryPolicy backoff — but never
+        past the deadline. In partial mode a rank still BUSY after the
+        retry budget is reported in ``missing`` (with its BusyError) and
+        the merge proceeds without it; transport failures keep their
+        single-attempt degrade-fast semantics.
         """
         q_size = query.shape[0]
         if self.cfg is None:
@@ -372,10 +383,18 @@ class IndexClient:
                 "IndexClient has no cfg for this index: pass cfg_path at "
                 "construction, or call create_index/load_index first"
             )
+        abs_deadline = None if deadline is None else time.time() + deadline
         maximize_metric = self.cfg.metric == "dot"
         if not allow_partial:
+            # BUSY (and only BUSY) retries here: transport errors keep the
+            # reference's fail-fast contract in strict mode, while an
+            # overloaded rank gets the RetryPolicy's jittered backoff
             results = self.pool.imap(
-                lambda idx: idx.search(index_id, query, topk, return_embeddings),
+                lambda idx: self.retry.run_filtered(
+                    (rpc.BusyError,), abs_deadline, idx.generic_fun,
+                    "search", (index_id, query, topk, return_embeddings),
+                    None, deadline=abs_deadline,
+                ),
                 self.sub_indexes,
             )
             return IndexClient._aggregate_results(
@@ -384,18 +403,25 @@ class IndexClient:
 
         def one(idx):
             try:
-                return idx.generic_fun(
+                return self.retry.run_filtered(
+                    (rpc.BusyError,), abs_deadline, idx.generic_fun,
                     "search", (index_id, query, topk, return_embeddings),
-                    timeout=partial_timeout,
+                    None, timeout=partial_timeout, deadline=abs_deadline,
                 )
             # TRANSPORT failures only (dead/unreachable/hung rank — OSError
             # covers refused/reset/broken-pipe/socket-timeout; EOFError a
-            # mid-frame stream end). A ServerException means the rank is
-            # alive and rejected the request (index not loaded, not
-            # trained, bad args): masking it as "missing" would silently
-            # drop a healthy shard's corpus from every result, so it
-            # propagates in partial mode too.
-            except (OSError, EOFError) as e:
+            # mid-frame stream end), plus a rank still BUSY after the retry
+            # budget or one that shed this rank's request past its deadline
+            # (alive but overloaded — partial mode's contract is best-effort
+            # results from whoever can serve in time; healthy ranks that
+            # answered in-budget must not be discarded because one shard
+            # couldn't). A ServerException means the rank is alive and
+            # rejected the request (index not loaded, not trained, bad
+            # args): masking it as "missing" would silently drop a healthy
+            # shard's corpus from every result, so it propagates in partial
+            # mode too.
+            except (OSError, EOFError, rpc.BusyError,
+                    rpc.DeadlineExceeded) as e:
                 logger.warning(
                     "rank %s (%s:%s) unreachable during search; serving "
                     "partial results: %s", idx.id, idx.host, idx.port, e,

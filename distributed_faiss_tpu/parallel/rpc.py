@@ -108,6 +108,11 @@ KIND_CALL = 0
 KIND_RESULT = 1
 KIND_ERROR = 2
 KIND_CLOSE = 3
+# structured admission-control rejection (serving scheduler): the payload is
+# a dict with at least {"reason": "queue_full" | "deadline"}. Distinct from
+# KIND_ERROR because it is an expected, retryable load-shedding signal, not
+# a server-side exception with a traceback.
+KIND_BUSY = 4
 
 _HDR = struct.Struct("<4sBII")
 
@@ -118,6 +123,22 @@ class ClientExit(Exception):
 
 class ServerException(Exception):
     """A remote exception, carrying the server-side traceback text."""
+
+
+class BusyError(Exception):
+    """The server shed this request (scheduler queue full). The rank is
+    alive and healthy — retry after backoff (RetryPolicy treats this as
+    retryable), don't reroute or mark the rank dead."""
+
+    def __init__(self, message: str, info: dict = None):
+        super().__init__(message)
+        self.info = dict(info or {})
+
+
+class DeadlineExceeded(Exception):
+    """The call's deadline passed — either client-side before send, or
+    server-side before the request reached the device. NOT retryable: the
+    budget is already spent; retrying can only miss it again."""
 
 
 class FrameError(RuntimeError):
@@ -136,22 +157,32 @@ class FrameError(RuntimeError):
 # misconfigured shard).
 TRANSPORT_ERRORS = (OSError, EOFError, FrameError, pickle.UnpicklingError)
 
+# retryable = transport failures PLUS structured load-shedding (BUSY). Kept
+# separate from TRANSPORT_ERRORS because transport classification also
+# drives rerouting and partial-search "rank missing" decisions, where a
+# busy-but-alive rank must NOT count as dead.
+RETRYABLE_ERRORS = TRANSPORT_ERRORS + (BusyError,)
+
 
 class RetryPolicy:
-    """Bounded exponential backoff with jitter, for TRANSPORT errors only.
+    """Bounded exponential backoff with jitter for transient failures:
+    TRANSPORT errors and structured BUSY load-shedding.
 
     The write path wraps per-rank RPCs in ``run``: a call that fails with a
-    transport error (rank dead, connection reset, deadline expired) is
-    re-attempted up to ``max_attempts`` times, sleeping
+    transport error (rank dead, connection reset, deadline expired) or a
+    BUSY rejection (scheduler queue full — the rank is alive but shedding
+    load) is re-attempted up to ``max_attempts`` times, sleeping
     ``base_delay * multiplier**attempt`` (capped at ``max_delay``) between
     attempts, with +/- ``jitter`` fractional randomization so a fleet of
-    retrying clients doesn't stampede a restarting rank in lockstep.
-    Application errors (ServerException and anything else non-transport)
-    propagate immediately — they are deterministic and retrying them only
-    hides the real failure.
+    retrying clients doesn't stampede a restarting (or overloaded) rank in
+    lockstep. Application errors (ServerException and anything else
+    non-retryable) propagate immediately — they are deterministic and
+    retrying them only hides the real failure. DeadlineExceeded is likewise
+    never retried: the call's budget is already spent.
     """
 
     transport_errors = TRANSPORT_ERRORS
+    retryable_errors = RETRYABLE_ERRORS
 
     def __init__(self, max_attempts: int = 3, base_delay: float = 0.05,
                  multiplier: float = 2.0, max_delay: float = 2.0,
@@ -167,7 +198,7 @@ class RetryPolicy:
         self.jitter = jitter
 
     def is_retryable(self, exc: BaseException) -> bool:
-        return isinstance(exc, self.transport_errors)
+        return isinstance(exc, self.retryable_errors)
 
     def delay(self, attempt: int) -> float:
         """Sleep before retry number ``attempt`` (0-based: the delay between
@@ -178,14 +209,25 @@ class RetryPolicy:
         return max(0.0, d)
 
     def run(self, fn, *args, **kwargs):
-        """Call ``fn(*args, **kwargs)``, retrying transport failures."""
+        """Call ``fn(*args, **kwargs)``, retrying transient failures."""
+        return self.run_filtered(self.retryable_errors, None, fn,
+                                 *args, **kwargs)
+
+    def run_filtered(self, retryable, abs_deadline, fn, *args, **kwargs):
+        """``run`` with an explicit retryable-exception tuple and an
+        optional absolute ``time.time()`` deadline: a retry whose backoff
+        sleep would land past the deadline is abandoned (the exception
+        propagates) instead of burning budget the caller no longer has."""
         for attempt in range(self.max_attempts):
             try:
                 return fn(*args, **kwargs)
-            except self.transport_errors:
+            except retryable:
                 if attempt + 1 >= self.max_attempts:
                     raise
-                time.sleep(self.delay(attempt))
+                d = self.delay(attempt)
+                if abs_deadline is not None and time.time() + d >= abs_deadline:
+                    raise
+                time.sleep(d)
 
 
 class _TensorRef:
@@ -308,6 +350,15 @@ class Client:
     # during an outage pays the redial budget once per cooldown window,
     # not once per search
     REDIAL_COOLDOWN = 2.0
+    # slack added to the socket wait when it is derived from a deadline:
+    # the server rebases the stamped budget at frame DECODE time (strictly
+    # later than our send), so a socket wait of exactly the budget would
+    # always fire before the server's flush-time shed frame (BUSY
+    # reason=deadline) could arrive — the structured DeadlineExceeded would
+    # be unreachable and every expiry would cost a torn connection. A
+    # result landing inside the grace was dispatched pre-deadline and is
+    # still correct; a truly hung rank is bounded at budget + grace.
+    DEADLINE_GRACE = 0.5
 
     def __init__(self, client_id: int, host: str, port: int, v6: bool = False,
                  connect_timeout: float = 60.0):
@@ -347,13 +398,26 @@ class Client:
                 time.sleep(delay)
                 delay = min(delay * 1.6, 2.0)
 
-    def generic_fun(self, fname: str, args=(), kwargs=None, timeout: float = None):
+    def generic_fun(self, fname: str, args=(), kwargs=None, timeout: float = None,
+                    deadline: float = None):
         """Remote call. With ``timeout``, the socket gets a deadline for this
         call; on expiry the connection is closed (a partial frame would
         desync the stream) and socket.timeout propagates. Any transport
         failure likewise drops the connection, and the NEXT call redials
         (RECONNECT_TIMEOUT) — so a rank restarted on the same host:port
-        rejoins the fan-out without rebuilding the IndexClient."""
+        rejoins the fan-out without rebuilding the IndexClient.
+
+        ``deadline`` is an absolute ``time.time()`` instant: the REMAINING
+        budget is stamped into the call frame (as a relative duration —
+        clock-skew-safe) so the server's scheduler can shed the request
+        unserved once it can no longer answer in time, and it also bounds
+        the socket wait. An already-expired deadline raises
+        ``DeadlineExceeded`` without touching the wire."""
+        if deadline is not None and deadline - time.time() <= 0:
+            # cheap fast-fail before contending for the stub lock
+            raise DeadlineExceeded(
+                f"deadline expired {time.time() - deadline:.3f}s before "
+                f"calling {fname}")
         with self._lock:
             if self._shutdown:
                 raise RuntimeError(f"client to {self.host}:{self.port} is closed")
@@ -368,10 +432,31 @@ class Client:
                     self._next_redial = time.time() + self.REDIAL_COOLDOWN
                     raise
                 self._closed = False
+            # budget is computed HERE — after the lock wait and any redial —
+            # so the stamped value reflects what genuinely remains; a budget
+            # measured at entry could be stale by a whole in-flight call
+            # from another thread plus RECONNECT_TIMEOUT
+            budget = None
+            if deadline is not None:
+                budget = deadline - time.time()
+                if budget <= 0:
+                    raise DeadlineExceeded(
+                        f"deadline expired {-budget:.3f}s before sending "
+                        f"{fname}")
+                # socket wait = budget + grace, so the server's structured
+                # shed response can win the race against our own timeout
+                wait = budget + self.DEADLINE_GRACE
+                timeout = wait if timeout is None else min(timeout, wait)
             # pack BEFORE touching the socket: a client-side pickling failure
             # (unpicklable argument) must raise without tearing down a
-            # healthy connection — zero bytes have hit the wire
-            parts = pack_frame(KIND_CALL, (fname, tuple(args), kwargs or {}))
+            # healthy connection — zero bytes have hit the wire.
+            # The 4th payload element (frame meta) is only added when a
+            # deadline is set, so deadline-less frames stay byte-compatible
+            # with pre-deadline peers.
+            payload = (fname, tuple(args), kwargs or {})
+            if budget is not None:
+                payload = payload + ({"deadline_s": budget},)
+            parts = pack_frame(KIND_CALL, payload)
             if timeout is not None:
                 self.sock.settimeout(timeout)
             try:
@@ -394,6 +479,15 @@ class Client:
             return payload
         if kind == KIND_ERROR:
             raise ServerException(payload)
+        if kind == KIND_BUSY:
+            info = payload if isinstance(payload, dict) else {}
+            if info.get("reason") == "deadline":
+                raise DeadlineExceeded(
+                    f"server shed {fname}: deadline expired before dispatch")
+            raise BusyError(
+                f"server shed {fname}: {info.get('reason', 'busy')} "
+                f"(queue {info.get('queue_depth', '?')}/"
+                f"{info.get('max_queue', '?')})", info)
         raise RuntimeError(f"unexpected frame kind {kind}")
 
     def __getattr__(self, name: str):

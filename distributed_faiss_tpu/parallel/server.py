@@ -26,13 +26,19 @@ import selectors
 import socket
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from distributed_faiss_tpu.engine import Index
 from distributed_faiss_tpu.parallel import rpc
-from distributed_faiss_tpu.utils.config import IndexCfg
+from distributed_faiss_tpu.serving.scheduler import (
+    DeadlineExpired,
+    SchedulerBusy,
+    SchedulerStopped,
+    SearchScheduler,
+)
+from distributed_faiss_tpu.utils.config import IndexCfg, SchedulerCfg
 from distributed_faiss_tpu.utils.state import IndexState
 from distributed_faiss_tpu.utils.tracing import LatencyStats
 
@@ -51,7 +57,8 @@ def setup_server_logging(level=logging.INFO) -> None:
 
 
 class IndexServer:
-    def __init__(self, rank: int, index_storage_dir: str):
+    def __init__(self, rank: int, index_storage_dir: str,
+                 scheduler_cfg: Optional[SchedulerCfg] = None):
         self.indexes: Dict[str, Index] = {}
         self.indexes_lock = threading.Lock()
         self.rank = rank
@@ -59,6 +66,20 @@ class IndexServer:
         self.socket: Optional[socket.socket] = None
         self._stopping = threading.Event()
         self.perf = LatencyStats()  # per-RPC latency counters (SURVEY §5.1)
+        # background work (async training) runs on named, tracked threads so
+        # stop() can wait for them instead of orphaning device work
+        self._threads_lock = threading.Lock()
+        self._train_threads: List[threading.Thread] = []
+        # serving scheduler: both serving loops hand `search` RPCs to its
+        # bounded queue + batcher thread (serving/scheduler.py); every other
+        # op keeps the direct dispatch path. DFT_SCHEDULER=0 (or an explicit
+        # cfg with enabled=False) restores pre-scheduler direct serving.
+        cfg = scheduler_cfg if scheduler_cfg is not None else SchedulerCfg.from_env()
+        self.scheduler: Optional[SearchScheduler] = None
+        if cfg.enabled:
+            self.scheduler = SearchScheduler(
+                self._engine_search_batched, cfg,
+                name=f"search-batcher:r{rank}")
 
     # ------------------------------------------------------------ RPC surface
 
@@ -88,11 +109,34 @@ class IndexServer:
             query_batch, top_k=top_k, return_embeddings=return_embeddings
         )
 
+    def _engine_search_batched(self, index_id: str, query_batch: np.ndarray,
+                               top_k: int, return_embeddings: bool) -> Tuple:
+        """The scheduler's launch target: the engine's already-batched
+        entry (the scheduler has coalesced the callers; engine.py
+        search_batched skips the in-process natural batcher)."""
+        return self._get_index(index_id).search_batched(
+            query_batch, top_k=top_k, return_embeddings=return_embeddings
+        )
+
     def sync_train(self, index_id: str) -> None:
         self._get_index(index_id).train()
 
     def async_train(self, index_id: str) -> None:
-        _thread.start_new_thread(self._get_index(index_id).train, ())
+        # a named, tracked thread (not _thread.start_new_thread, which is
+        # invisible to shutdown): stop() joins whatever is still training
+        index = self._get_index(index_id)
+        t = threading.Thread(
+            target=index.train, name=f"train:{index_id}:r{self.rank}",
+            daemon=True)
+        with self._threads_lock:
+            # prune only threads that have RUN and finished (ident set, not
+            # alive); and start inside the lock, so a concurrent stop() can
+            # never snapshot — and try to join — a not-yet-started thread
+            self._train_threads = [
+                x for x in self._train_threads
+                if x.ident is None or x.is_alive()]
+            self._train_threads.append(t)
+            t.start()
 
     def get_state(self, index_id: str) -> IndexState:
         return self._get_index(index_id).get_state()
@@ -168,8 +212,16 @@ class IndexServer:
         os.environ["OMP_NUM_THREADS"] = str(num_threads)
 
     def get_perf_stats(self) -> dict:
-        """Per-RPC latency summary {method: {count, total_s, mean_s, max_s}}."""
-        return self.perf.summary()
+        """Per-RPC latency summary {method: {count, total_s, mean_s, max_s,
+        p50_s, p95_s, p99_s}}; with the serving scheduler enabled, the
+        ``"scheduler"`` key adds its queue/batch distributions (queue_wait_s,
+        e2e_s, batch_requests, batch_rows, queue_depth) and admission
+        counters (submitted, batches, shed_deadline, rejected_busy,
+        queued) — see docs/OPERATIONS.md#serving-scheduler."""
+        out = self.perf.summary()
+        if self.scheduler is not None:
+            out["scheduler"] = self.scheduler.perf_stats()
+        return out
 
     def ping(self) -> dict:
         """Liveness/health probe (the reference has no failure detection
@@ -210,6 +262,20 @@ class IndexServer:
                 pass
             self.socket.close()
             self.socket = None
+        # stop admitting/serving scheduled searches before saving: queued
+        # requests fail fast with a structured rejection instead of racing
+        # the save for the index locks
+        if self.scheduler is not None:
+            self.scheduler.stop()
+        # wait (bounded) for tracked async-training threads so a shutdown
+        # can't orphan a half-trained index mid-save
+        with self._threads_lock:
+            train_threads = list(self._train_threads)
+        for t in train_threads:
+            t.join(timeout=30.0)
+            if t.is_alive():
+                logger.warning("training thread %s still running at stop; "
+                               "its index will not be saved trained", t.name)
         with self.indexes_lock:
             indexes = list(self.indexes.values())
         for index in indexes:
@@ -259,27 +325,62 @@ class IndexServer:
             except OSError:
                 pass
 
-    def _one_call(self, conn: socket.socket) -> None:
+    def _one_call(self, conn: socket.socket, eager_search: bool = False) -> None:
         kind, payload = rpc.recv_frame(conn)
         if kind == rpc.KIND_CLOSE:
             raise rpc.ClientExit("client closed")
         if kind != rpc.KIND_CALL:
             raise RuntimeError(f"unexpected frame kind {kind}")
-        fname, args, kwargs = payload
+        # 3-tuple (legacy) or 4-tuple with frame meta carrying the caller's
+        # remaining deadline budget (relative seconds — clock-skew-safe;
+        # rebased onto this host's monotonic clock at decode)
+        fname, args, kwargs = payload[:3]
+        frame_meta = payload[3] if len(payload) > 3 else None
+        deadline = None
+        if isinstance(frame_meta, dict) and frame_meta.get("deadline_s") is not None:
+            deadline = time.monotonic() + float(frame_meta["deadline_s"])
+        t0 = time.perf_counter()
         try:
             fn = getattr(self, fname)
             if fname.startswith("_"):
                 raise AttributeError(fname)
-            t0 = time.perf_counter()
-            ret = fn(*args, **kwargs)
+            if fname == "search" and self.scheduler is not None:
+                # admission-controlled path: queue bound + deadline shedding
+                ret = self._scheduled_search(args, kwargs, deadline,
+                                             eager_search)
+            else:
+                ret = fn(*args, **kwargs)
             self.perf.record(fname, time.perf_counter() - t0)
             rpc.send_frame(conn, rpc.KIND_RESULT, ret)
+        except SchedulerBusy as e:
+            self.perf.record("search:busy", time.perf_counter() - t0)
+            rpc.send_frame(conn, rpc.KIND_BUSY, {
+                "reason": "queue_full",
+                "queue_depth": e.queue_depth,
+                "max_queue": e.max_queue,
+            })
+        except SchedulerStopped:
+            self.perf.record("search:busy", time.perf_counter() - t0)
+            rpc.send_frame(conn, rpc.KIND_BUSY, {"reason": "stopping"})
+        except DeadlineExpired:
+            self.perf.record("search:shed", time.perf_counter() - t0)
+            rpc.send_frame(conn, rpc.KIND_BUSY, {"reason": "deadline"})
         except Exception:
             import traceback
 
             tb = traceback.format_exc()
             logger.error("exception in %s: %s", fname, tb)
             rpc.send_frame(conn, rpc.KIND_ERROR, tb)
+
+    def _scheduled_search(self, args, kwargs, deadline, eager=False):
+        """Normalize a search RPC's args onto the scheduler's submit."""
+        vals = dict(zip(
+            ("index_id", "query_batch", "top_k", "return_embeddings"), args))
+        vals.update(kwargs or {})
+        return self.scheduler.submit(
+            vals["index_id"], vals["query_batch"], vals["top_k"],
+            bool(vals.get("return_embeddings", False)), deadline=deadline,
+            eager=eager)
 
     def start(self, port: int = rpc.DEFAULT_PORT, v6: bool = False) -> None:
         """Selector-based single-thread loop. The reference ships a broken
@@ -306,7 +407,12 @@ class IndexServer:
                 else:
                     conn = key.fileobj
                     try:
-                        self._one_call(conn)
+                        # eager_search: this loop is single-threaded, so a
+                        # scheduled search blocks the only serving thread —
+                        # followers structurally cannot arrive during the
+                        # flush window; waiting for them would be pure
+                        # added latency. Admission control still applies.
+                        self._one_call(conn, eager_search=True)
                     except (rpc.ClientExit, EOFError, OSError):
                         sel.unregister(conn)
                         conn.close()

@@ -1,0 +1,25 @@
+"""Serving-side request scheduling (cross-request micro-batching).
+
+The reference serves one RPC per connection thread with no coalescing
+(distributed_faiss/server.py:95-135); under concurrent load every
+``search`` RPC pays its own device dispatch. ``SearchScheduler`` puts a
+bounded, deadline-aware queue and a batcher thread between the serving
+loops and the engine: concurrent searches for the same index coalesce
+into one padded device batch, results split back per caller, and
+admission control sheds work the rank cannot serve in time (BUSY /
+expired-deadline structured rejections instead of unbounded queueing).
+"""
+
+from distributed_faiss_tpu.serving.scheduler import (
+    DeadlineExpired,
+    SchedulerBusy,
+    SchedulerStopped,
+    SearchScheduler,
+)
+
+__all__ = [
+    "SearchScheduler",
+    "SchedulerBusy",
+    "SchedulerStopped",
+    "DeadlineExpired",
+]

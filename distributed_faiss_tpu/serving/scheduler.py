@@ -1,0 +1,324 @@
+"""Deadline-aware micro-batching scheduler for the serving path.
+
+One ``SearchScheduler`` per server rank. Connection threads (or the
+selector loop) call ``submit``; a single named batcher thread drains the
+queue, coalesces compatible requests — same ``(index_id, top_k,
+return_embeddings, dim)`` — into one concatenated device batch, runs the
+engine's batched search entry once, and hands every caller its row
+slice. Two flush triggers: the pending compatible rows reach
+``max_batch_rows``, or the oldest queued request has waited
+``max_wait_ms``.
+
+Admission control (the backpressure contract, docs/OPERATIONS.md):
+
+- a request whose deadline has already passed is rejected with
+  ``DeadlineExpired`` before it can occupy queue space — and a request
+  whose deadline expires while queued is shed at flush time, in both
+  cases without touching the device;
+- a request arriving while ``max_queue`` requests are pending is
+  rejected with ``SchedulerBusy`` — the RPC layer turns this into a
+  structured BUSY response that clients retry under their RetryPolicy
+  backoff, so overload degrades into client-side pacing instead of an
+  unbounded server-side queue.
+
+Identity invariant (tested in tests/test_scheduler_identity.py): query
+rows are independent in every index's search, so a caller's slice of the
+merged launch is bit-identical to the result of serving its request
+alone. The splitter routes rows purely positionally from the extraction
+order — a caller can get *no* result or an error, never another
+caller's rows.
+
+Observability rides the shared ``LatencyStats`` histogram surface
+(utils/tracing.py): queue-wait and end-to-end latency with streaming
+percentiles, batch occupancy (requests and rows per launch), queue depth
+at flush, and monotonic shed/busy counters — all exported through the
+rank's ``get_perf_stats`` RPC under the ``"scheduler"`` key.
+"""
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from distributed_faiss_tpu.utils.config import SchedulerCfg
+from distributed_faiss_tpu.utils.tracing import LatencyStats
+
+logger = logging.getLogger()
+
+
+class SchedulerBusy(RuntimeError):
+    """The request queue is full: the rank is overloaded. Retryable —
+    clients back off and retry (rpc.BusyError client-side)."""
+
+    def __init__(self, queue_depth: int, max_queue: int):
+        self.queue_depth = queue_depth
+        self.max_queue = max_queue
+        super().__init__(
+            f"scheduler queue full ({queue_depth}/{max_queue} requests)"
+        )
+
+
+class DeadlineExpired(RuntimeError):
+    """The request's deadline passed before it reached the device.
+    Not retryable — the client's budget is already gone."""
+
+
+class SchedulerStopped(RuntimeError):
+    """The scheduler was stopped while this request was queued."""
+
+
+class _Request:
+    __slots__ = ("index_id", "q", "k", "return_embeddings", "deadline",
+                 "eager", "enqueue_t", "event", "result", "error")
+
+    def __init__(self, index_id: str, q: np.ndarray, k: int,
+                 return_embeddings: bool, deadline: Optional[float],
+                 eager: bool = False):
+        self.index_id = index_id
+        self.q = q
+        self.k = k
+        self.return_embeddings = return_embeddings
+        self.deadline = deadline  # absolute time.monotonic(), or None
+        self.eager = eager  # head of queue flushes without the wait window
+        self.enqueue_t = time.monotonic()
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+    @property
+    def key(self) -> Tuple:
+        return (self.index_id, self.k, self.return_embeddings, self.q.shape[1])
+
+    @property
+    def rows(self) -> int:
+        return self.q.shape[0]
+
+
+def _split_rows(value, offsets: List[Tuple[int, int]]):
+    """Split one element of a batched search result back per caller.
+
+    ndarrays and lists split along the leading (row) axis; None (e.g. the
+    embeddings slot when not requested) and scalars broadcast unchanged.
+    """
+    if value is None:
+        return [None] * len(offsets)
+    if isinstance(value, np.ndarray):
+        return [value[lo:hi] for lo, hi in offsets]
+    if isinstance(value, list):
+        return [value[lo:hi] for lo, hi in offsets]
+    return [value] * len(offsets)
+
+
+class SearchScheduler:
+    """Bounded queue + batcher thread coalescing concurrent searches.
+
+    ``search_fn(index_id, query_batch, top_k, return_embeddings)`` is the
+    engine's already-batched entry (engine.Index.search_batched on a
+    server); it must return a tuple whose ndarray/list elements have one
+    leading row per query row.
+    """
+
+    def __init__(self, search_fn: Callable, cfg: Optional[SchedulerCfg] = None,
+                 name: str = "search-batcher"):
+        self._search_fn = search_fn
+        self.cfg = cfg if cfg is not None else SchedulerCfg()
+        self._cond = threading.Condition()
+        self._queue: List[_Request] = []
+        self._stopping = False
+        self.stats = LatencyStats()
+        self._counters = {
+            "submitted": 0,
+            "batches": 0,
+            "shed_deadline": 0,
+            "rejected_busy": 0,
+        }
+        self._thread = threading.Thread(
+            target=self._batcher_loop, name=name, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ client side
+
+    def submit(self, index_id: str, query_batch: np.ndarray, top_k: int,
+               return_embeddings: bool = False,
+               deadline: Optional[float] = None, eager: bool = False):
+        """Enqueue one search and block until its slice of a merged launch
+        is ready. ``deadline`` is an absolute ``time.monotonic()`` instant;
+        expired requests never reach the device. ``eager`` skips the
+        max-wait window when this request heads the queue — for callers
+        that cannot overlap (the single-threaded selector loop, where
+        waiting for followers that structurally cannot arrive would add
+        max_wait_ms of pure latency); admission control and coalescing
+        with already-queued requests still apply."""
+        q = np.asarray(query_batch, np.float32)
+        if q.ndim != 2:
+            raise ValueError(f"query batch must be 2-D, got shape {q.shape}")
+        req = _Request(index_id, q, int(top_k), bool(return_embeddings),
+                       deadline, eager=eager)
+        with self._cond:
+            if self._stopping:
+                raise SchedulerStopped("scheduler is stopped")
+            if deadline is not None and time.monotonic() >= deadline:
+                self._counters["shed_deadline"] += 1
+                raise DeadlineExpired(
+                    "deadline expired before the request was admitted")
+            if len(self._queue) >= self.cfg.max_queue:
+                self._counters["rejected_busy"] += 1
+                raise SchedulerBusy(len(self._queue), self.cfg.max_queue)
+            self._counters["submitted"] += 1
+            self._queue.append(req)
+            self._cond.notify_all()
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        self.stats.record("e2e_s", time.monotonic() - req.enqueue_t)
+        return req.result
+
+    # ----------------------------------------------------------- batcher side
+
+    def _batcher_loop(self) -> None:
+        while True:
+            try:
+                batch = self._next_batch()
+            except BaseException:
+                # the flush-wait itself failed (allocation under memory
+                # pressure, a bug in the trigger logic): the thread MUST
+                # survive — callers blocked in submit's untimed event.wait
+                # would otherwise hang forever. Fail whatever is queued and
+                # keep serving.
+                logger.exception("scheduler flush-wait failed")
+                with self._cond:
+                    stranded, self._queue = self._queue, []
+                for r in stranded:
+                    r.error = RuntimeError("scheduler internal error")
+                    r.event.set()
+                time.sleep(0.05)  # never spin hot on a persistent failure
+                continue
+            if batch is None:
+                return  # stopped; stop() already drained the queue
+            try:
+                self._serve(batch)
+            except BaseException:  # the loop must survive any launch failure
+                logger.exception("scheduler batch failed")
+                for r in batch:
+                    if not r.event.is_set():
+                        if r.error is None and r.result is None:
+                            r.error = RuntimeError("scheduled search aborted")
+                        r.event.set()
+
+    def _next_batch(self) -> Optional[List[_Request]]:
+        """Block until a flush trigger fires; pop and return one batch of
+        compatible requests (FIFO from the head's group)."""
+        max_wait_s = self.cfg.max_wait_ms / 1000.0
+        with self._cond:
+            while True:
+                if self._stopping:
+                    return None
+                if not self._queue:
+                    self._cond.wait()
+                    continue
+                head = self._queue[0]
+                rows = sum(r.rows for r in self._queue if r.key == head.key)
+                flush_at = head.enqueue_t + max_wait_s
+                now = time.monotonic()
+                if (not head.eager and rows < self.cfg.max_batch_rows
+                        and now < flush_at):
+                    self._cond.wait(flush_at - now)
+                    continue
+                # pop whole compatible requests until the row budget is
+                # reached; a single over-budget request still goes alone
+                # (requests are never split)
+                taken, taken_rows, rest = [], 0, []
+                for r in self._queue:
+                    if (r.key == head.key
+                            and (taken_rows < self.cfg.max_batch_rows)):
+                        taken.append(r)
+                        taken_rows += r.rows
+                    else:
+                        rest.append(r)
+                self._queue = rest
+                self.stats.record("queue_depth", float(len(rest)))
+                return taken
+
+    def _serve(self, batch: List[_Request]) -> None:
+        now = time.monotonic()
+        live: List[_Request] = []
+        for r in batch:
+            if r.deadline is not None and now >= r.deadline:
+                # shed without touching the device; the device batch only
+                # carries rows someone is still waiting for
+                with self._cond:
+                    self._counters["shed_deadline"] += 1
+                r.error = DeadlineExpired(
+                    "deadline expired while queued "
+                    f"(waited {now - r.enqueue_t:.3f}s)")
+                r.event.set()
+                continue
+            self.stats.record("queue_wait_s", now - r.enqueue_t)
+            live.append(r)
+        if not live:
+            return
+        with self._cond:
+            self._counters["batches"] += 1
+        self.stats.record("batch_requests", float(len(live)))
+        self.stats.record("batch_rows", float(sum(r.rows for r in live)))
+        head = live[0]
+        try:
+            qcat = head.q if len(live) == 1 else np.concatenate(
+                [r.q for r in live], axis=0)
+            result = self._search_fn(
+                head.index_id, qcat, head.k, head.return_embeddings)
+            if not isinstance(result, tuple):
+                result = (result,)
+            offsets, ofs = [], 0
+            for r in live:
+                offsets.append((ofs, ofs + r.rows))
+                ofs += r.rows
+            per_elem = [_split_rows(v, offsets) for v in result]
+            for i, r in enumerate(live):
+                r.result = tuple(elem[i] for elem in per_elem)
+        except Exception as exc:
+            # one application error fails exactly the callers whose rows
+            # shared the launch — never the rest of the queue. Each caller
+            # gets its OWN exception object: submit() re-raises from N
+            # threads concurrently, and raising one shared instance races
+            # on its __traceback__ (interleaved frames in error reports).
+            for r in live:
+                try:
+                    err = type(exc)(*exc.args)
+                except Exception:
+                    err = RuntimeError(f"scheduled search failed: {exc!r}")
+                err.__cause__ = exc
+                r.error = err
+        finally:
+            for r in live:
+                if r.error is None and r.result is None:
+                    r.error = RuntimeError("scheduled search aborted")
+                r.event.set()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def stop(self) -> None:
+        """Stop the batcher and fail everything still queued (callers see
+        ``SchedulerStopped``; in-flight launches complete normally)."""
+        with self._cond:
+            self._stopping = True
+            stranded, self._queue = self._queue, []
+            self._cond.notify_all()
+        for r in stranded:
+            r.error = SchedulerStopped("scheduler stopped with request queued")
+            r.event.set()
+        self._thread.join(timeout=10.0)
+        if self._thread.is_alive():  # pragma: no cover - launch wedged in device
+            logger.warning("scheduler batcher thread did not exit in 10s")
+
+    # ---------------------------------------------------------- observability
+
+    def perf_stats(self) -> dict:
+        """{"counters": {...}, "queues": {metric: histogram summary}} —
+        merged into the rank's get_perf_stats surface under "scheduler"."""
+        with self._cond:
+            counters = dict(self._counters)
+            counters["queued"] = len(self._queue)
+        return {"counters": counters, "queues": self.stats.summary()}

@@ -1,25 +1,38 @@
 """Tracing / profiling / metrics.
 
 The reference has none of this beyond log lines (SURVEY §5.1); here:
-- ``LatencyStats``  — lock-protected per-operation latency counters; the
-  server records every RPC dispatch and exposes them via the
-  ``get_perf_stats`` RPC (observability the reference lacks).
+- ``LatencyStats``  — lock-protected per-operation latency counters with
+  streaming percentiles (fixed log-spaced histogram buckets); the server
+  records every RPC dispatch and exposes them via the ``get_perf_stats``
+  RPC (observability the reference lacks). The serving scheduler records
+  queue-wait / batch-occupancy / queue-depth distributions into the same
+  structure (serving/scheduler.py).
 - ``traced``        — context manager stamping a jax.named_scope (visible in
   xprof/tensorboard traces) and recording wall time into a LatencyStats.
 - ``profile_trace`` — wrapper around jax.profiler for capturing device
   traces around a code block (TPU xprof dumps).
 """
 
+import bisect
 import contextlib
 import threading
 import time
 from typing import Dict, Optional
+
+# Streaming-percentile histogram: fixed log-spaced bucket upper bounds from
+# 1 µs to 10^3 s, 5 buckets per decade (ratio 10^(1/5) ≈ 1.58x — the
+# worst-case relative error of a reported percentile). Fixed buckets keep
+# ``record`` O(log n_buckets) with O(1) memory per op name, so the serving
+# hot path can afford per-request recording (a sorted reservoir would not).
+_BUCKET_BOUNDS = tuple(1e-6 * 10 ** (i / 5) for i in range(46))
+_PERCENTILES = ((0.50, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s"))
 
 
 class LatencyStats:
     def __init__(self):
         self._lock = threading.Lock()
         self._stats: Dict[str, Dict[str, float]] = {}
+        self._hist: Dict[str, list] = {}
 
     def record(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -29,6 +42,33 @@ class LatencyStats:
             s["count"] += 1
             s["total_s"] += seconds
             s["max_s"] = max(s["max_s"], seconds)
+            hist = self._hist.setdefault(name, [0] * len(_BUCKET_BOUNDS))
+            # bucket i holds values <= bounds[i]; out-of-range clamps to the
+            # last bucket (its reported percentile saturates at the top edge)
+            hist[min(bisect.bisect_left(_BUCKET_BOUNDS, seconds),
+                     len(_BUCKET_BOUNDS) - 1)] += 1
+
+    @staticmethod
+    def _percentiles(hist, count, max_s) -> Dict[str, float]:
+        """Percentile estimates off the log-bucket histogram: the reported
+        value is the upper edge of the bucket containing the quantile rank
+        (<= 10^(1/5)x above the true value), capped at the exact max."""
+        out = {}
+        targets = [(q * count, key) for q, key in _PERCENTILES]
+        cum = 0
+        ti = 0
+        last = len(hist) - 1
+        for i, n in enumerate(hist):
+            cum += n
+            while ti < len(targets) and cum >= targets[ti][0]:
+                # the last bucket is unbounded above (out-of-range clamps),
+                # so its only honest upper estimate is the exact max
+                est = max_s if i == last else min(_BUCKET_BOUNDS[i], max_s)
+                out[targets[ti][1]] = est
+                ti += 1
+            if ti == len(targets):
+                break
+        return out
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -36,11 +76,14 @@ class LatencyStats:
             for name, s in self._stats.items():
                 out[name] = dict(s)
                 out[name]["mean_s"] = s["total_s"] / max(s["count"], 1)
+                out[name].update(self._percentiles(
+                    self._hist[name], s["count"], s["max_s"]))
             return out
 
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()
+            self._hist.clear()
 
 
 @contextlib.contextmanager

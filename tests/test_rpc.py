@@ -425,3 +425,130 @@ def test_wire_frames_decode_through_restricted_unpickler():
     np.testing.assert_array_equal(
         args[0], np.arange(6, dtype=np.float32).reshape(2, 3))
     assert args[1] == [("m", 0), ("m", 1)]
+
+
+# ---------------------------------------------- deadline + BUSY (scheduler)
+
+
+class _RecordingServer:
+    """One-connection raw server scripting KIND_BUSY / result responses and
+    recording the decoded call frames (to assert deadline stamping)."""
+
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.frames = []
+        self.port = free_port()
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("", self.port))
+        self._lsock.listen(5)
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        try:
+            conn, _ = self._lsock.accept()
+            while self.responses:
+                kind, payload = rpc.recv_frame(conn)
+                if kind == rpc.KIND_CLOSE:
+                    return
+                self.frames.append(payload)
+                rkind, rpayload = self.responses.pop(0)
+                rpc.send_frame(conn, rkind, rpayload)
+        except (EOFError, OSError):
+            pass
+
+    def close(self):
+        self._lsock.close()
+
+
+def test_busy_frame_raises_busy_error_and_is_retryable():
+    srv = _RecordingServer([
+        (rpc.KIND_BUSY, {"reason": "queue_full", "queue_depth": 9,
+                         "max_queue": 9}),
+        (rpc.KIND_RESULT, "served"),
+    ])
+    c = rpc.Client(0, "localhost", srv.port)
+    with pytest.raises(rpc.BusyError) as ei:
+        c.generic_fun("search", ("idx",))
+    assert ei.value.info["queue_depth"] == 9
+    # the connection survives a BUSY rejection (it is a structured
+    # response, not a transport fault) — and RetryPolicy retries it
+    assert rpc.RetryPolicy().is_retryable(ei.value)
+    assert c.generic_fun("search", ("idx",)) == "served"
+    c.close()
+    srv.close()
+
+
+def test_busy_frame_with_deadline_reason_raises_deadline_exceeded():
+    srv = _RecordingServer([(rpc.KIND_BUSY, {"reason": "deadline"})])
+    c = rpc.Client(0, "localhost", srv.port)
+    with pytest.raises(rpc.DeadlineExceeded):
+        c.generic_fun("search", ("idx",))
+    # NOT retryable: the budget is already spent
+    assert not rpc.RetryPolicy().is_retryable(rpc.DeadlineExceeded("x"))
+    c.close()
+    srv.close()
+
+
+def test_deadline_stamped_as_relative_budget_in_frame():
+    srv = _RecordingServer([
+        (rpc.KIND_RESULT, "ok"),
+        (rpc.KIND_RESULT, "ok"),
+    ])
+    c = rpc.Client(0, "localhost", srv.port)
+    # no deadline -> legacy 3-tuple frame (wire-compatible with old peers)
+    assert c.generic_fun("ping", ()) == "ok"
+    assert c.generic_fun("ping", (), deadline=time.time() + 5.0) == "ok"
+    deadline = time.time() + 5
+    while len(srv.frames) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(srv.frames[0]) == 3
+    assert len(srv.frames[1]) == 4
+    budget = srv.frames[1][3]["deadline_s"]
+    assert 0.0 < budget <= 5.0  # RELATIVE seconds, clock-skew-safe
+    c.close()
+    srv.close()
+
+
+def test_expired_deadline_fails_before_touching_the_wire():
+    srv = _RecordingServer([(rpc.KIND_RESULT, "never")])
+    c = rpc.Client(0, "localhost", srv.port)
+    with pytest.raises(rpc.DeadlineExceeded):
+        c.generic_fun("search", ("idx",), deadline=time.time() - 0.1)
+    time.sleep(0.1)
+    assert srv.frames == []  # zero bytes hit the wire
+    # connection is still healthy for the next (in-budget) call
+    assert c.generic_fun("search", ("idx",)) == "never"
+    c.close()
+    srv.close()
+
+
+def test_retry_policy_run_filtered_respects_deadline():
+    calls = []
+
+    def always_busy():
+        calls.append(time.time())
+        raise rpc.BusyError("busy")
+
+    p = rpc.RetryPolicy(max_attempts=10, base_delay=0.2, jitter=0.0)
+    t0 = time.time()
+    with pytest.raises(rpc.BusyError):
+        p.run_filtered((rpc.BusyError,), t0 + 0.3, always_busy)
+    # backoff abandoned once the next sleep would land past the deadline:
+    # far fewer than max_attempts, and no sleep beyond the budget
+    assert len(calls) < 10
+    assert time.time() - t0 < 1.0
+
+
+def test_retry_policy_run_retries_busy_then_succeeds():
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise rpc.BusyError("busy")
+        return "done"
+
+    p = rpc.RetryPolicy(max_attempts=3, base_delay=0.001, jitter=0.0)
+    assert p.run(flaky) == "done"
+    assert state["n"] == 3

@@ -1,0 +1,342 @@
+"""Serving-scheduler unit tests (serving/scheduler.py): coalescing and
+per-caller result routing, admission control (queue bound -> BUSY,
+deadline shedding before device touch), group isolation, stop semantics,
+config knobs, and the perf-stats surface. Pure threads + numpy — no
+device work, so these run in tier-1."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.serving import (
+    DeadlineExpired,
+    SchedulerBusy,
+    SchedulerStopped,
+    SearchScheduler,
+)
+from distributed_faiss_tpu.utils.config import SchedulerCfg
+
+# fast (no device, no subprocess): these ALSO run in tier-1; the marker
+# additionally pulls them into the dedicated scheduler CI job
+pytestmark = pytest.mark.scheduler
+
+
+class FakeEngine:
+    """Deterministic per-row search: scores[i] = row-sum, ids[i] = arange.
+    Records every launch (thread-safe) so tests can assert coalescing."""
+
+    def __init__(self, delay=0.0, fail_index=None):
+        self.calls = []
+        self.lock = threading.Lock()
+        self.delay = delay
+        self.fail_index = fail_index
+
+    def __call__(self, index_id, q, k, return_embeddings):
+        with self.lock:
+            self.calls.append((index_id, q.shape, k, return_embeddings))
+        if self.delay:
+            time.sleep(self.delay)
+        if index_id == self.fail_index:
+            raise RuntimeError(f"boom on {index_id}")
+        scores = np.repeat(q.sum(axis=1, keepdims=True), k, axis=1)
+        ids = np.tile(np.arange(k, dtype=np.int64), (q.shape[0], 1))
+        meta = [[(index_id, float(row.sum()), j) for j in range(k)] for row in q]
+        return scores, meta, None
+
+
+def expected(q, k, index_id="idx"):
+    scores = np.repeat(np.asarray(q, np.float32).sum(axis=1, keepdims=True), k, axis=1)
+    meta = [[(index_id, float(row.sum()), j) for j in range(k)]
+            for row in np.asarray(q, np.float32)]
+    return scores, meta
+
+
+def test_coalesces_concurrent_requests_and_routes_slices():
+    engine = FakeEngine()
+    sched = SearchScheduler(engine, SchedulerCfg(
+        max_wait_ms=150.0, max_batch_rows=1024, max_queue=64))
+    n_threads, rows = 6, 3
+    queries = [np.full((rows, 4), float(t), np.float32) + np.arange(4)
+               for t in range(n_threads)]
+    results = [None] * n_threads
+    barrier = threading.Barrier(n_threads)
+
+    def client(t):
+        barrier.wait()
+        results[t] = sched.submit("idx", queries[t], 5)
+
+    ts = [threading.Thread(target=client, args=(t,)) for t in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # every caller got ITS rows back, bit-identical to a solo launch
+    for t in range(n_threads):
+        scores, meta, embs = results[t]
+        exp_scores, exp_meta = expected(queries[t], 5)
+        np.testing.assert_array_equal(scores, exp_scores)
+        assert meta == exp_meta
+        assert embs is None
+    # and the launches coalesced: far fewer calls than callers, total rows
+    # conserved exactly once
+    assert len(engine.calls) < n_threads
+    assert sum(shape[0] for _, shape, _, _ in engine.calls) == n_threads * rows
+    sched.stop()
+
+
+def test_flushes_on_max_batch_rows_without_waiting():
+    engine = FakeEngine()
+    sched = SearchScheduler(engine, SchedulerCfg(
+        max_wait_ms=5000.0, max_batch_rows=4, max_queue=64))
+    t0 = time.monotonic()
+    out = sched.submit("idx", np.ones((4, 2), np.float32), 3)
+    assert time.monotonic() - t0 < 2.0  # row trigger, not the 5s window
+    np.testing.assert_array_equal(out[0], expected(np.ones((4, 2)), 3)[0])
+    sched.stop()
+
+
+def test_incompatible_groups_never_share_a_launch():
+    engine = FakeEngine()
+    sched = SearchScheduler(engine, SchedulerCfg(
+        max_wait_ms=100.0, max_batch_rows=1024, max_queue=64))
+    outs = {}
+    barrier = threading.Barrier(3)
+
+    def client(name, index_id, k, dim):
+        q = np.ones((2, dim), np.float32)
+        barrier.wait()
+        outs[name] = (sched.submit(index_id, q, k), q)
+
+    ts = [threading.Thread(target=client, args=a) for a in
+          [("a", "idx", 3, 4), ("b", "idx", 7, 4), ("c", "other", 3, 4)]]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # no launch mixed (index_id, k): every recorded call is homogeneous
+    keys = {(iid, k) for iid, _shape, k, _re in engine.calls}
+    assert keys == {("idx", 3), ("idx", 7), ("other", 3)}
+    assert outs["a"][0][0].shape == (2, 3)
+    assert outs["b"][0][0].shape == (2, 7)
+    sched.stop()
+
+
+def test_queue_full_rejects_with_busy():
+    release = threading.Event()
+    entered = threading.Event()
+
+    def blocking_engine(index_id, q, k, re):
+        entered.set()
+        release.wait(10.0)
+        return (np.zeros((q.shape[0], k), np.float32),)
+
+    sched = SearchScheduler(blocking_engine, SchedulerCfg(
+        max_wait_ms=0.0, max_batch_rows=1, max_queue=1))
+    q = np.zeros((1, 2), np.float32)
+    t1 = threading.Thread(target=lambda: sched.submit("idx", q, 1))
+    t1.start()
+    assert entered.wait(5.0)  # batcher is now blocked inside the launch
+    t2 = threading.Thread(target=lambda: sched.submit("idx", q, 1))
+    t2.start()
+    deadline = time.time() + 5.0
+    while sched.perf_stats()["counters"]["queued"] < 1:
+        assert time.time() < deadline
+        time.sleep(0.005)
+    with pytest.raises(SchedulerBusy) as ei:
+        sched.submit("idx", q, 1)
+    assert ei.value.queue_depth == 1 and ei.value.max_queue == 1
+    assert sched.perf_stats()["counters"]["rejected_busy"] == 1
+    release.set()
+    t1.join()
+    t2.join()
+    sched.stop()
+
+
+def test_expired_deadline_rejected_before_device():
+    engine = FakeEngine()
+    sched = SearchScheduler(engine, SchedulerCfg(max_wait_ms=0.0))
+    with pytest.raises(DeadlineExpired):
+        sched.submit("idx", np.zeros((1, 2), np.float32), 1,
+                     deadline=time.monotonic() - 0.1)
+    assert engine.calls == []  # never touched the "device"
+    assert sched.perf_stats()["counters"]["shed_deadline"] == 1
+    sched.stop()
+
+
+def test_deadline_expiring_in_queue_is_shed_at_flush():
+    release = threading.Event()
+    calls = []
+
+    def blocking_engine(index_id, q, k, re):
+        calls.append(q.shape)
+        release.wait(10.0)
+        return (np.zeros((q.shape[0], k), np.float32),)
+
+    sched = SearchScheduler(blocking_engine, SchedulerCfg(
+        max_wait_ms=0.0, max_batch_rows=1, max_queue=16))
+    q = np.zeros((1, 2), np.float32)
+    t1 = threading.Thread(target=lambda: sched.submit("idx", q, 1))
+    t1.start()
+    deadline = time.time() + 5.0
+    while not calls:  # batcher is blocked serving the first request
+        assert time.time() < deadline
+        time.sleep(0.005)
+    errs = []
+
+    def doomed():
+        try:
+            sched.submit("idx", q, 1, deadline=time.monotonic() + 0.05)
+        except Exception as e:
+            errs.append(e)
+
+    t2 = threading.Thread(target=doomed)
+    t2.start()
+    time.sleep(0.2)  # let the doomed request expire while queued
+    release.set()
+    t1.join()
+    t2.join()
+    assert len(errs) == 1 and isinstance(errs[0], DeadlineExpired)
+    assert len(calls) == 1  # the expired request never reached the engine
+    sched.stop()
+
+
+def test_group_failure_isolated_to_its_callers():
+    engine = FakeEngine(fail_index="bad")
+    sched = SearchScheduler(engine, SchedulerCfg(max_wait_ms=50.0))
+    out = {}
+    barrier = threading.Barrier(2)
+
+    def client(name, iid):
+        barrier.wait()
+        try:
+            out[name] = sched.submit(iid, np.ones((1, 2), np.float32), 2)
+        except Exception as e:
+            out[name] = e
+
+    ts = [threading.Thread(target=client, args=a)
+          for a in [("ok", "good"), ("bad", "bad")]]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert isinstance(out["bad"], RuntimeError)
+    assert "boom" in str(out["bad"])
+    scores, _meta, _ = out["ok"]
+    assert scores.shape == (1, 2)
+    # the scheduler survives the failure and keeps serving
+    again = sched.submit("good", np.ones((1, 2), np.float32), 2)
+    assert again[0].shape == (1, 2)
+    sched.stop()
+
+
+def test_stop_fails_pending_and_future_submits():
+    release = threading.Event()
+
+    def blocking_engine(index_id, q, k, re):
+        release.wait(10.0)
+        return (np.zeros((q.shape[0], k), np.float32),)
+
+    sched = SearchScheduler(blocking_engine, SchedulerCfg(
+        max_wait_ms=0.0, max_batch_rows=1, max_queue=16))
+    q = np.zeros((1, 2), np.float32)
+    errs = []
+    t1 = threading.Thread(target=lambda: sched.submit("idx", q, 1))
+    t1.start()
+    deadline = time.time() + 5.0
+    while sched.perf_stats()["counters"]["submitted"] < 1:
+        assert time.time() < deadline
+        time.sleep(0.005)
+
+    def queued():
+        try:
+            sched.submit("idx", q, 1)
+        except Exception as e:
+            errs.append(e)
+
+    t2 = threading.Thread(target=queued)
+    t2.start()
+    while sched.perf_stats()["counters"]["queued"] < 1:
+        assert time.time() < deadline
+        time.sleep(0.005)
+    # stop() drains the queue first (failing t2 with SchedulerStopped),
+    # then joins the batcher — which is still blocked in t1's launch, so
+    # release it once t2's rejection has landed
+    stopper = threading.Thread(target=sched.stop)
+    stopper.start()
+    while not errs:
+        assert time.time() < deadline
+        time.sleep(0.005)
+    release.set()  # in-flight launch completes normally for t1
+    stopper.join()
+    t1.join()
+    t2.join()
+    assert len(errs) == 1 and isinstance(errs[0], SchedulerStopped)
+    with pytest.raises(SchedulerStopped):
+        sched.submit("idx", q, 1)
+
+
+def test_perf_stats_surface():
+    engine = FakeEngine()
+    sched = SearchScheduler(engine, SchedulerCfg(max_wait_ms=0.0))
+    sched.submit("idx", np.ones((2, 3), np.float32), 4)
+    stats = sched.perf_stats()
+    assert stats["counters"]["submitted"] == 1
+    assert stats["counters"]["batches"] == 1
+    for metric in ("queue_wait_s", "e2e_s", "batch_requests", "batch_rows",
+                   "queue_depth"):
+        assert metric in stats["queues"], metric
+        for key in ("count", "mean_s", "max_s", "p50_s", "p95_s", "p99_s"):
+            assert key in stats["queues"][metric], (metric, key)
+    assert stats["queues"]["batch_rows"]["max_s"] == 2.0
+    sched.stop()
+
+
+def test_eager_submit_skips_the_wait_window():
+    """eager=True (the selector loop, which cannot overlap callers) must
+    flush immediately instead of idling out the max-wait window."""
+    engine = FakeEngine()
+    sched = SearchScheduler(engine, SchedulerCfg(
+        max_wait_ms=5000.0, max_batch_rows=1024, max_queue=16))
+    t0 = time.monotonic()
+    out = sched.submit("idx", np.ones((1, 2), np.float32), 3, eager=True)
+    assert time.monotonic() - t0 < 2.0  # not the 5s window
+    np.testing.assert_array_equal(out[0], expected(np.ones((1, 2)), 3)[0])
+    sched.stop()
+
+
+def test_rejects_non_2d_queries():
+    sched = SearchScheduler(FakeEngine(), SchedulerCfg(max_wait_ms=0.0))
+    with pytest.raises(ValueError, match="2-D"):
+        sched.submit("idx", np.zeros(4, np.float32), 1)
+    sched.stop()
+
+
+# ------------------------------------------------------------- SchedulerCfg
+
+
+def test_scheduler_cfg_defaults_and_validation():
+    cfg = SchedulerCfg()
+    assert cfg.enabled and cfg.max_batch_rows == 256
+    assert cfg.max_wait_ms == 2.0 and cfg.max_queue == 512
+    with pytest.raises(TypeError):
+        SchedulerCfg(nope=1)
+    with pytest.raises(ValueError):
+        SchedulerCfg(max_batch_rows=0)
+    with pytest.raises(ValueError):
+        SchedulerCfg(max_queue=0)
+    with pytest.raises(ValueError):
+        SchedulerCfg(max_wait_ms=-1.0)
+
+
+def test_scheduler_cfg_from_env():
+    env = {"DFT_SCHEDULER": "0", "DFT_SCHED_MAX_BATCH": "32",
+           "DFT_SCHED_MAX_WAIT_MS": "7.5", "DFT_SCHED_MAX_QUEUE": "9"}
+    cfg = SchedulerCfg.from_env(env)
+    assert cfg.enabled is False
+    assert cfg.max_batch_rows == 32
+    assert cfg.max_wait_ms == 7.5
+    assert cfg.max_queue == 9
+    assert SchedulerCfg.from_env({}).enabled is True
+    assert SchedulerCfg.from_env({"DFT_SCHEDULER": "1"}).enabled is True

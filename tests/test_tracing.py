@@ -26,6 +26,34 @@ def test_latency_stats_concurrent():
     assert stats.summary() == {}
 
 
+def test_latency_stats_streaming_percentiles():
+    """p50/p95/p99 from the fixed log-spaced histogram: each estimate is
+    the containing bucket's upper edge — within one bucket ratio
+    (10^(1/5) ~ 1.58x) above the true quantile, never below it, and capped
+    at the exact max."""
+    stats = LatencyStats()
+    # 100 distinct values spanning ~2 decades: true p50=0.00505, p99=0.01
+    for i in range(1, 101):
+        stats.record("op", i * 1e-4)
+    s = stats.summary()["op"]
+    for key, true_q in (("p50_s", 0.00505), ("p95_s", 0.0095),
+                        ("p99_s", 0.0099)):
+        assert true_q <= s[key] <= true_q * 1.585, (key, s[key])
+    assert s["p50_s"] <= s["p95_s"] <= s["p99_s"] <= s["max_s"]
+
+
+def test_latency_stats_percentiles_degenerate_and_extreme():
+    stats = LatencyStats()
+    stats.record("one", 0.01)  # single sample: all percentiles == max
+    s = stats.summary()["one"]
+    assert s["p50_s"] == s["p95_s"] == s["p99_s"] == 0.01
+    # values beyond the bucket range clamp (no crash, capped at exact max)
+    stats.record("huge", 1e9)
+    stats.record("tiny", 1e-12)
+    assert stats.summary()["huge"]["p99_s"] == 1e9
+    assert stats.summary()["tiny"]["p99_s"] <= 1e-6
+
+
 def test_traced_records_and_scopes():
     stats = LatencyStats()
     with traced("block", stats):

@@ -41,6 +41,14 @@ PINS = {
     ("ChaosProxy", "_accepted"): "_lock",
     ("ChaosProxy", "_default_fault"): "_lock",
     ("ServerHarness", "procs"): "_lock",
+    # serving scheduler thread state (serving/scheduler.py): the request
+    # queue and admission counters are shared between every connection
+    # thread (submit) and the batcher thread, all under the flush condition;
+    # the server's tracked async-training threads live under their own lock
+    ("SearchScheduler", "_queue"): "_cond",
+    ("SearchScheduler", "_counters"): "_cond",
+    ("SearchScheduler", "_stopping"): "_cond",
+    ("IndexServer", "_train_threads"): "_threads_lock",
 }
 
 _SKIP_METHODS = frozenset({"__init__", "__new__", "__del__"})
