@@ -19,9 +19,23 @@ plus the serving-scheduler A/B (``--scheduler``, default both arms):
                   queue + batcher thread, 2 ms flush window), the path
                   the RPC serving loops use
 
-The scheduler arms also cross-check RESULT IDENTITY: every client's
-scheduler-on (scores, ids) must be byte-identical to its scheduler-off
-results (the batch a row rides in must not change its answer).
+plus the RPC-multiplexing A/B (``--mux``, default both arms): a real
+IndexServer + ONE IndexClient driven by ``--inflight`` threads over
+loopback.
+
+  rpc_mux_off — the serial stub (DFT_RPC_MUX=0): the stub lock holds the
+                connection for the whole round trip, so one call is in
+                flight per rank no matter how many caller threads
+  rpc_mux_on  — pipelined stub: the whole in-flight window rides one
+                connection and reaches the server scheduler TOGETHER, so
+                a single client's W concurrent searches become merged
+                device batches (the row reports the max merged
+                batch_requests the scheduler observed — >1 is impossible
+                in the off arm)
+
+The scheduler AND mux arms cross-check RESULT IDENTITY: every client's
+results must be byte-identical to direct/sequential serving (the batch
+or connection a row rides must not change its answer).
 
 On a launch-bound backend (the TPU relay: ~66 ms/dispatch —
 benchmarks/profile_ivf.py) batching multiplies multi-client QPS; on CPU
@@ -145,12 +159,147 @@ def check_identity(idx, arms, queries, k, reps=3):
     return identical              # not stamp the direct-launch row false
 
 
+def run_mux_arms(idx, queries, k, arm, inflight, reps, backend,
+                 mux_batch=4):
+    """RPC-level A/B: one IndexServer (blocking loop, scheduler on) serving
+    the already-trained engine, ONE IndexClient per arm, ``inflight``
+    caller threads. Returns one JSON-ready row per arm.
+
+    Requests are ``mux_batch`` rows each (default 4): individual user
+    queries are small, and small launches sit on the per-dispatch floor —
+    the regime multiplexing exists for. The serial arm pays one floor per
+    request, serialized; the mux arm's in-flight window coalesces into one
+    launch per flush (every backend has a dispatch floor; the TPU relay's
+    ~66 ms just makes the same crossover much larger)."""
+    import socket as socketlib
+    import tempfile
+
+    from distributed_faiss_tpu.parallel.client import IndexClient
+    from distributed_faiss_tpu.parallel.server import IndexServer
+    from distributed_faiss_tpu.utils.config import SchedulerCfg
+
+    tmp = tempfile.mkdtemp(prefix="mux_bench_")
+    s = socketlib.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    srv = IndexServer(0, tmp, scheduler_cfg=SchedulerCfg(max_wait_ms=2.0))
+    srv.indexes["bench"] = idx  # serve the trained engine directly
+    threading.Thread(target=srv.start_blocking, args=(port,),
+                     daemon=True).start()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            socketlib.create_connection(("localhost", port), timeout=1).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+    disc = os.path.join(tmp, "disc.txt")
+    with open(disc, "w") as f:
+        f.write(f"1\nlocalhost,{port}\n")
+
+    qlist = [queries[t % len(queries)][:mux_batch] for t in range(inflight)]
+    # warm every merged-batch jit bucket the scheduler can produce (2..W
+    # coalesced requests): without this, first-use compiles of the larger
+    # row counts land inside the measured window and dominate the mux
+    # arm's p99 (the serial arm only ever launches the native size)
+    warm = np.concatenate(qlist, axis=0)
+    for rows in range(mux_batch, mux_batch * inflight + 1, mux_batch):
+        idx.search_batched(warm[:rows], k)
+    arms = [("rpc_mux_off", "0")] if arm in ("off", "both") else []
+    if arm in ("on", "both"):
+        arms.append(("rpc_mux_on", "1"))
+
+    rows = []
+    saved = os.environ.get("DFT_RPC_MUX")
+    try:
+        # golden: sequential serving through a serial client
+        os.environ["DFT_RPC_MUX"] = "0"
+        ref = IndexClient(disc)
+        ref.cfg = idx.cfg
+        golden = [ref.search(q, k, "bench") for q in qlist]
+        ref.close()
+        for name, env in arms:
+            os.environ["DFT_RPC_MUX"] = env
+            client = IndexClient(disc)
+            client.cfg = idx.cfg
+            srv.scheduler.stats.reset()  # per-arm merged-batch observation
+
+            res = [[] for _ in qlist]
+            errs = []
+            barrier = threading.Barrier(inflight)
+
+            def caller(t, client=client, res=res, errs=errs,
+                       barrier=barrier):
+                barrier.wait()
+                try:
+                    for _ in range(reps):
+                        res[t].append(client.search(qlist[t], k, "bench"))
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            ts = [threading.Thread(target=caller, args=(t,))
+                  for t in range(inflight)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs, (name, errs[:1])
+            identical = all(
+                len(res[t]) == reps
+                and all(np.array_equal(sc, golden[t][0]) and m == golden[t][1]
+                        for sc, m in res[t])
+                for t in range(len(qlist)))
+
+            qps, p99 = run_clients(
+                lambda q, kk, client=client: client.search(q, kk, "bench"),
+                qlist, inflight, reps, k)
+            merged = srv.scheduler.stats.summary().get(
+                "batch_requests", {}).get("max_s", 0.0)
+            rows.append({
+                "case": name, "backend": backend, "threads": inflight,
+                "batch": qlist[0].shape[0], "qps": round(qps, 1),
+                "p99_ms": round(p99, 2), "identical": identical,
+                "merged_batch_max": merged,
+            })
+            client.close()
+    finally:
+        if saved is None:
+            os.environ.pop("DFT_RPC_MUX", None)
+        else:
+            os.environ["DFT_RPC_MUX"] = saved
+        # light teardown: no srv.stop() — it would save the whole bench
+        # corpus; the process exits right after the arms
+        srv._stopping.set()
+        if srv.socket is not None:
+            try:
+                srv.socket.close()
+            except OSError:
+                pass
+        if srv.scheduler is not None:
+            srv.scheduler.stop()
+    return rows
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
         "--scheduler", choices=("on", "off", "both", "none"), default="both",
         help="serving-scheduler A/B arm(s) to run (default: both, with a "
              "result-identity cross-check)")
+    parser.add_argument(
+        "--mux", choices=("on", "off", "both", "none"), default="both",
+        help="RPC-multiplexing A/B arm(s): real server + ONE IndexClient "
+             "over loopback (default: both, with identity cross-check and "
+             "the merged-batch observation)")
+    parser.add_argument(
+        "--inflight", type=int, default=8, metavar="W",
+        help="concurrent caller threads on the single mux-arm client "
+             "(the per-connection in-flight window; default 8)")
+    parser.add_argument(
+        "--mux-batch", type=int, default=4,
+        help="rows per request in the mux arms (default 4: user-sized "
+             "requests riding the per-launch dispatch floor)")
     parser.add_argument(
         "--modes", default="percall,natural,window",
         help="comma list of legacy batcher modes to run ('' = skip)")
@@ -212,6 +361,20 @@ def main():
             }), flush=True)
         assert all(identical.values()), \
             f"results diverged from direct launches: {identical}"
+
+    if args.mux != "none":
+        rows = run_mux_arms(idx, queries, k, args.mux, args.inflight,
+                            reps, backend, mux_batch=args.mux_batch)
+        for row in rows:
+            print(json.dumps(row), flush=True)
+        assert all(r["identical"] for r in rows), \
+            f"mux results diverged from sequential serving: {rows}"
+        by_case = {r["case"]: r for r in rows}
+        if "rpc_mux_on" in by_case:
+            # the tentpole observation: a single client's in-flight window
+            # reached the scheduler as one merged batch (impossible with
+            # the serial stub)
+            assert by_case["rpc_mux_on"]["merged_batch_max"] > 1, by_case
 
 
 if __name__ == "__main__":

@@ -40,6 +40,7 @@ from distributed_faiss_tpu.utils.serialization import (
     save_state,
 )
 from distributed_faiss_tpu.utils.state import IndexState
+from distributed_faiss_tpu.utils.tracing import LatencyStats
 
 logger = logging.getLogger()
 
@@ -152,6 +153,11 @@ class Index:
 
         self.index_save_time = time.time()
         self.index_saved_size = 0
+        # device-launch latency/occupancy distributions, surfaced through
+        # the server's get_perf_stats "engine" key — lets operators read
+        # wire round-trip (client rpc stats), queue wait (scheduler), and
+        # device time side by side when tuning pipelining depth
+        self.perf = LatencyStats()
         # newest committed snapshot generation in this shard's storage dir
         # (0 = nothing committed yet; from_storage_dir seeds it on restore)
         self._generation = 0
@@ -364,7 +370,11 @@ class Index:
         with self.index_lock:
             if self.state != IndexState.TRAINED:
                 raise RuntimeError(f"Server index is not trained. state: {self.state}")
-            return self.tpu_index.search(query_batch, top_k)
+            t0 = time.perf_counter()
+            out = self.tpu_index.search(query_batch, top_k)
+            self.perf.record("device_search_s", time.perf_counter() - t0)
+            self.perf.record("device_search_rows", float(query_batch.shape[0]))
+            return out
 
     def search(
         self, query_batch: np.ndarray, top_k: int = 100, return_embeddings: bool = False
@@ -408,7 +418,10 @@ class Index:
             if self.state != IndexState.TRAINED:
                 raise RuntimeError(
                     f"Server index is not trained. state: {self.state}")
+            t0 = time.perf_counter()
             scores, indexes = self.tpu_index.search(query_batch, top_k)
+            self.perf.record("reconstruct_search_s",
+                             time.perf_counter() - t0)
             flat = indexes.reshape(-1)
             if self.tpu_index.ntotal == 0:
                 # trained-but-empty window: all ids are -1
@@ -445,6 +458,14 @@ class Index:
             nq, k = indexes.shape
             embs = [[embs_arr[i, j] for j in range(k)] for i in range(nq)]
         return scores, results_meta, embs
+
+    def perf_stats(self) -> dict:
+        """Per-index device-launch latency summary: ``device_search_s``
+        (wall time of each locked launch), ``device_search_rows`` (rows per
+        launch — the "_s" suffix on summary keys is historical; these are
+        counts), ``reconstruct_search_s`` (search+reconstruct launches).
+        Served through IndexServer.get_perf_stats under ``"engine"``."""
+        return self.perf.summary()
 
     def get_centroids(self):
         with self.index_lock:

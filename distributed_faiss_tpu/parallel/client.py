@@ -2,7 +2,8 @@
 
 Behavioral parity with the reference's ``IndexClient``
 (distributed_faiss/client.py:57-345): discovery-file wait with exponential
-backoff, one RPC stub + pool thread per server, round-robin add placement,
+backoff, one (multiplexed) RPC stub per server with a sized fan-out
+executor (DFT_CLIENT_POOL), round-robin add placement,
 fan-out search with client-side top-k merge (negated-dot semantics), filtered
 search with 3x over-fetch, cluster state aggregation, and broadcast ops
 (save/load/drop/ntotal/ids/centroids/nprobe).
@@ -27,7 +28,7 @@ import logging
 import os
 import random
 import time
-from multiprocessing.dummy import Pool as ThreadPool
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -37,6 +38,20 @@ from distributed_faiss_tpu.utils.config import IndexCfg
 from distributed_faiss_tpu.utils.state import IndexState
 
 logger = logging.getLogger()
+
+
+def client_pool_size(num_indexes: int) -> int:
+    """Fan-out worker budget for one IndexClient. The old fixed
+    ``ThreadPool(num_indexes)`` capped the whole client at ONE full
+    fan-out's concurrency: K user threads all queued behind N pool slots,
+    so multi-threaded callers never put more than one search per rank in
+    flight (and the RPC mux had nothing to pipeline). ``DFT_CLIENT_POOL``
+    overrides; the default budgets 8 concurrent full fan-outs (executor
+    threads spawn lazily, so an idle budget costs nothing)."""
+    raw = os.environ.get("DFT_CLIENT_POOL")
+    if raw:
+        return max(int(raw), num_indexes)
+    return 8 * max(num_indexes, 1)
 
 
 def merge_result_blocks(
@@ -115,7 +130,14 @@ class IndexClient:
         index_ranks = [idx.get_rank() for idx in self.sub_indexes]
         self.index_rank_to_id = {r: i for i, r in enumerate(index_ranks)}
 
-        self.pool = ThreadPool(self.num_indexes)
+        # fan-out executor: sized for several concurrent fan-outs (see
+        # client_pool_size) so K user threads x N ranks pipeline over the
+        # mux stubs instead of queueing behind N slots.
+        # (ThreadPoolExecutor.map matches the old ThreadPool.map contract:
+        # eager submission, results in stub order.)
+        self.pool = ThreadPoolExecutor(
+            max_workers=client_pool_size(self.num_indexes),
+            thread_name_prefix="indexclient-fanout")
         self.cur_server_ids = {}
         # private RNG for round-robin start placement: the reference's
         # random.seed(time.time()) stomps the GLOBAL RNG state of the host
@@ -212,7 +234,7 @@ class IndexClient:
                 )
                 return False, e
 
-        raw = self.pool.map(one, self.sub_indexes)
+        raw = list(self.pool.map(one, self.sub_indexes))
         outcomes = []
         for stub, (ok, val) in zip(self.sub_indexes, raw):
             o = {"server": stub.id, "host": stub.host, "port": stub.port, "ok": ok}
@@ -389,7 +411,7 @@ class IndexClient:
             # BUSY (and only BUSY) retries here: transport errors keep the
             # reference's fail-fast contract in strict mode, while an
             # overloaded rank gets the RetryPolicy's jittered backoff
-            results = self.pool.imap(
+            results = self.pool.map(
                 lambda idx: self.retry.run_filtered(
                     (rpc.BusyError,), abs_deadline, idx.generic_fun,
                     "search", (index_id, query, topk, return_embeddings),
@@ -428,7 +450,7 @@ class IndexClient:
                 )
                 return _FailedRank(idx, e)
 
-        raw = self.pool.map(one, self.sub_indexes)
+        raw = list(self.pool.map(one, self.sub_indexes))
         ok = [r for r in raw if not isinstance(r, _FailedRank)]
         missing = [
             {"server": r.stub.id, "host": r.stub.host, "port": r.stub.port,
@@ -549,10 +571,10 @@ class IndexClient:
     # ------------------------------------------------------------ observability
 
     def get_state(self, index_id: str) -> IndexState:
-        states = self.pool.map(
+        states = list(self.pool.map(
             lambda idx: self._call_with_retry(idx, "get_state", (index_id,)),
             self.sub_indexes,
-        )
+        ))
         return IndexState.get_aggregated_states(states)
 
     def get_ntotal(self, index_id: str) -> int:
@@ -572,17 +594,17 @@ class IndexClient:
         ))
 
     def get_ids(self, index_id: str) -> set:
-        id_sets = self.pool.map(
+        id_sets = list(self.pool.map(
             lambda idx: self._call_with_retry(idx, "get_ids", (index_id,)),
             self.sub_indexes,
-        )
+        ))
         return set().union(*id_sets)
 
     def get_centroids(self, index_id: str):
-        return self.pool.map(
+        return list(self.pool.map(
             lambda idx: self._call_with_retry(idx, "get_centroids", (index_id,)),
             self.sub_indexes,
-        )
+        ))
 
     def set_nprobe(self, index_id: str, nprobe: int):
         return self._broadcast("set_nprobe", (index_id, nprobe))
@@ -591,11 +613,21 @@ class IndexClient:
         self._broadcast("set_omp_num_threads", (num_threads,))
 
     def get_perf_stats(self) -> list:
-        """Per-server RPC latency summaries (observability, SURVEY §5.1)."""
-        return self.pool.map(
+        """Per-server RPC latency summaries (observability, SURVEY §5.1).
+
+        Each rank's entry gains an ``"rpc"``/``"client"`` sub-dict with the
+        CLIENT-side view of that rank's stub — instantaneous/peak
+        pipelining depth and wire round-trip percentiles — so operators
+        see mux depth and wire p99 next to the rank's own scheduler and
+        engine stats (docs/OPERATIONS.md#wire-protocol-appendix)."""
+        stats = list(self.pool.map(
             lambda idx: self._call_with_retry(idx, "get_perf_stats"),
             self.sub_indexes,
-        )
+        ))
+        for stub, entry in zip(self.sub_indexes, stats):
+            if isinstance(entry, dict) and hasattr(stub, "rpc_stats"):
+                entry.setdefault("rpc", {})["client"] = stub.rpc_stats()
+        return stats
 
     def ping(self, timeout: float = 10.0) -> list:
         """Health-check every server; returns per-server dicts or the error
@@ -616,7 +648,7 @@ class IndexClient:
                     "error": f"{type(e).__name__}: {e}",
                 }
 
-        return self.pool.map(one, self.sub_indexes)
+        return list(self.pool.map(one, self.sub_indexes))
 
     def get_num_servers(self) -> int:
         return self.num_indexes
@@ -624,4 +656,4 @@ class IndexClient:
     def close(self):
         for conn in self.sub_indexes:
             conn.close()
-        self.pool.terminate()
+        self.pool.shutdown(wait=False)

@@ -18,9 +18,21 @@ Design differences (conscious, SURVEY §2.4):
 Frame layout (little-endian):
   magic b"DFT1" | kind u8 | skel_len u32 | narr u32 | skel bytes |
   narr x [ dtype_len u8 | dtype utf8 | ndim u8 | dims u64* | data bytes ]
+
+Multiplexing (docs/OPERATIONS.md#wire-protocol-appendix): every CALL frame
+from a mux client carries a ``req_id`` in the optional trailing meta
+element (the same dict that carries ``deadline_s``), and the server
+answers with *tagged* response kinds (``KIND_*_MUX``) whose payload is
+``({"req_id": n}, body)`` — so many calls can be in flight per connection
+and complete out of order. Legacy peers interop: an old server ignores
+unknown meta keys and answers untagged (the demux attributes untagged
+responses FIFO, which is exact because a legacy server processes one
+frame per connection at a time), and an old client never sends ``req_id``
+so a mux server serves it on the unchanged synchronous in-order path.
 """
 
 import io
+import itertools
 import os
 import pickle
 import random
@@ -30,6 +42,8 @@ import threading
 import time
 
 import numpy as np
+
+from distributed_faiss_tpu.utils.tracing import LatencyStats
 
 DEFAULT_PORT = 12032  # same default port as the reference (rpc.py:22)
 
@@ -113,8 +127,48 @@ KIND_CLOSE = 3
 # KIND_ERROR because it is an expected, retryable load-shedding signal, not
 # a server-side exception with a traceback.
 KIND_BUSY = 4
+# req_id-tagged response variants (request multiplexing): payload is
+# ``({"req_id": n}, body)`` where body is exactly what the untagged kind
+# would have carried. A server only sends these in reply to a CALL frame
+# whose meta element carried a req_id, so legacy clients never see them.
+KIND_RESULT_MUX = 5
+KIND_ERROR_MUX = 6
+KIND_BUSY_MUX = 7
+
+# untagged kind -> its tagged variant (and back), for servers writing
+# req_id-tagged responses and the client-side demux unwrapping them
+MUX_RESPONSE_KINDS = {
+    KIND_RESULT: KIND_RESULT_MUX,
+    KIND_ERROR: KIND_ERROR_MUX,
+    KIND_BUSY: KIND_BUSY_MUX,
+}
+_MUX_TO_BASE = {v: k for k, v in MUX_RESPONSE_KINDS.items()}
 
 _HDR = struct.Struct("<4sBII")
+
+
+def mux_enabled_by_env() -> bool:
+    """DFT_RPC_MUX master switch (default on): 0 restores the serial
+    one-call-per-connection client (the pre-mux A/B arm)."""
+    return os.environ.get("DFT_RPC_MUX", "1") not in ("0", "false", "False", "")
+
+
+# kernel-level bound on a single zero-progress frame write, applied to
+# every mux-era socket (client stubs and server connections alike).
+# SO_SNDTIMEO affects send() only — a demux/connection reader blocked in
+# recv on the same socket is untouched — so a peer that stops draining
+# TCP turns an unbounded sendall into a transport error after this long,
+# instead of wedging the thread (and any lock it holds) forever.
+SEND_TIMEOUT_S = 30.0
+
+
+def bound_send_timeout(sock: socket.socket,
+                       seconds: float = SEND_TIMEOUT_S) -> None:
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                        struct.pack("ll", int(seconds), 0))
+    except (OSError, struct.error):  # pragma: no cover - exotic platform
+        pass
 
 
 class ClientExit(Exception):
@@ -299,6 +353,12 @@ def send_frame(sock: socket.socket, kind: int, obj=None) -> None:
     _send_parts(sock, pack_frame(kind, obj))
 
 
+def pack_tagged_response(base_kind: int, obj, req_id: int):
+    """Frame parts for a req_id-tagged response: the tagged variant of
+    ``base_kind`` (RESULT/ERROR/BUSY) carrying ``({"req_id": n}, obj)``."""
+    return pack_frame(MUX_RESPONSE_KINDS[base_kind], ({"req_id": int(req_id)}, obj))
+
+
 def _recv_exact(sock: socket.socket, n: int) -> memoryview:
     buf = bytearray(n)
     view = memoryview(buf)
@@ -337,9 +397,38 @@ def recv_frame(sock: socket.socket):
     return kind, _restore(skel, arrays)
 
 
+class _PendingCall:
+    """One in-flight mux call: the submitting thread blocks on ``event``;
+    the demux reader (or the connection-failure path) fills exactly one of
+    (kind, payload) or ``error`` BEFORE setting the event."""
+
+    __slots__ = ("req_id", "fname", "event", "kind", "payload", "error",
+                 "sent_t")
+
+    def __init__(self, req_id: int, fname: str):
+        self.req_id = req_id
+        self.fname = fname
+        self.event = threading.Event()
+        self.kind = None
+        self.payload = None
+        self.error = None
+        self.sent_t = time.monotonic()
+
+
 class Client:
     """Dynamic-dispatch RPC stub: any attribute is a remote method
-    (reference rpc.py:137-138). One persistent connection, thread-safe."""
+    (reference rpc.py:137-138). One persistent connection, thread-safe.
+
+    With multiplexing (the default; ``mux=False`` or DFT_RPC_MUX=0 restores
+    the serial client), ``_lock`` is held only for the atomic frame write:
+    each call registers a per-request completion slot keyed by ``req_id``,
+    a background demux reader routes tagged responses to their slots (and
+    untagged responses FIFO — exact for a legacy in-order server), and the
+    caller blocks on its own slot. Many calls are therefore in flight per
+    connection, completing out of order. Any transport failure fails ALL
+    in-flight calls with the error (TRANSPORT_ERRORS — so the existing
+    retry/reroute/BUSY machinery keeps working unchanged) and drops the
+    connection; the next call redials."""
 
     # redial budget for a stub whose previous call hit a transport failure:
     # short, so a still-dead rank fails fast inside degraded-mode fan-outs,
@@ -361,18 +450,42 @@ class Client:
     DEADLINE_GRACE = 0.5
 
     def __init__(self, client_id: int, host: str, port: int, v6: bool = False,
-                 connect_timeout: float = 60.0):
+                 connect_timeout: float = 60.0, mux: bool = None):
         self.id = client_id
         self.host = host
         self.port = port
         self._fam = socket.AF_INET6 if v6 else socket.AF_INET
-        self._connect(connect_timeout)
+        self._mux = mux_enabled_by_env() if mux is None else bool(mux)
         self._lock = threading.Lock()
         self._closed = False
         self._shutdown = False
         self._next_redial = 0.0
+        # mux state (all under _lock): in-flight slots by req_id — dict
+        # insertion order doubles as send order, which is what FIFO
+        # attribution of untagged (legacy-server) responses needs
+        self._pending = {}
+        # monotonic instant of the last frame received on the CURRENT
+        # connection: the stall evidence a per-call timeout consults
+        # before tearing the whole window down
+        self._last_rx = 0.0
+        # True once the peer has answered with a TAGGED response, False
+        # once it has answered untagged (legacy), None before the first
+        # response — decides whether a timed-out slot can be abandoned in
+        # place (tagged peers: the late response is dropped by req_id) or
+        # must tear the connection down (untagged peers: FIFO attribution
+        # would hand the late response to the NEXT caller)
+        self._peer_tagged = None
+        self._req_counter = itertools.count()
+        # bumped on every (re)connect AND every teardown: a stale reader
+        # (or a caller that raced a redial) can never fail the connection
+        # that replaced the one it was bound to
+        self._epoch = 0
+        self._reader = None
+        self._inflight_peak = 0
+        self.stats = LatencyStats()  # wire round-trip latency, per stub
+        self._connect(connect_timeout)
 
-    # graftlint: ok(lock-discipline): called only from __init__ (pre-threading) and generic_fun (holding _lock)
+    # graftlint: ok(lock-discipline): called only from __init__ (pre-threading) and under _lock via _ensure_connected
     def _connect(self, connect_timeout: float) -> None:
         # a server may register in the discovery file moments before its
         # accept loop is up (the reference has the same gap,
@@ -390,13 +503,109 @@ class Client:
                     max(0.05, min(connect_timeout, deadline - time.time())))
                 self.sock.connect((self.host, self.port))
                 self.sock.settimeout(None)
-                return
+                # bound zero-progress sends: the mux path writes under
+                # _lock with no per-call socket timeout (the demux reader
+                # owns recv), so without this a peer that stops draining
+                # TCP would wedge the whole stub — including the timeout
+                # teardown, which needs the same lock
+                bound_send_timeout(self.sock)
+                break
             except OSError:
                 self.sock.close()
                 if time.time() + delay > deadline:
                     raise
                 time.sleep(delay)
                 delay = min(delay * 1.6, 2.0)
+        self._epoch += 1
+        self._last_rx = time.monotonic()  # a fresh connection counts as live
+        self._peer_tagged = None  # a restarted peer may speak another dialect
+        if self._mux:
+            self._reader = threading.Thread(
+                target=self._reader_loop, args=(self.sock, self._epoch),
+                name=f"rpc-demux:{self.host}:{self.port}:c{self.id}",
+                daemon=True)
+            self._reader.start()
+
+    # ------------------------------------------------------------ mux plumbing
+
+    def _reader_loop(self, sock: socket.socket, epoch: int) -> None:
+        """Demux reader: one per connection generation. Routes tagged
+        responses to their slot by req_id, untagged ones FIFO (a legacy
+        server answers one frame at a time, in order, so the oldest
+        in-flight call is the only one it can be answering). Any transport
+        failure tears the connection down, failing every in-flight call."""
+        try:
+            while True:
+                kind, payload = recv_frame(sock)
+                base = _MUX_TO_BASE.get(kind)
+                tagged = base is not None
+                if tagged:
+                    meta, body = payload
+                    rid = meta.get("req_id") if isinstance(meta, dict) else None
+                else:
+                    base, body, rid = kind, payload, None
+                with self._lock:
+                    if epoch != self._epoch:
+                        return  # superseded by a redial/teardown
+                    self._last_rx = time.monotonic()
+                    self._peer_tagged = tagged
+                    if rid is None:
+                        rid = next(iter(self._pending), None)
+                    slot = self._pending.pop(rid, None)
+                if slot is None:
+                    continue  # response to an abandoned request: drop it
+                slot.kind, slot.payload = base, body
+                slot.event.set()
+        except BaseException as e:
+            self._fail_connection(sock, epoch, e)
+
+    def _fail_connection(self, sock, epoch: int, exc: BaseException) -> None:
+        with self._lock:
+            if epoch != self._epoch:
+                return  # a redial already replaced this connection
+            self._fail_locked(exc, sock=sock)
+
+    # graftlint: ok(lock-discipline): the _locked suffix is the contract — every caller holds _lock
+    def _fail_locked(self, exc: BaseException, sock=None) -> None:
+        """Tear down the current connection (lock held): mark closed, fail
+        every in-flight call with its own copy of ``exc``."""
+        self._epoch += 1
+        self._closed = True
+        stranded = list(self._pending.values())
+        self._pending.clear()
+        sock = self.sock if sock is None else sock
+        try:
+            sock.shutdown(socket.SHUT_RDWR)  # wake a reader blocked in recv
+        except OSError:
+            pass
+        sock.close()
+        for slot in stranded:
+            # each caller re-raises from its own thread: a shared exception
+            # instance would race on __traceback__ (same rationale as the
+            # scheduler's per-caller error copies)
+            try:
+                err = type(exc)(*exc.args)
+                err.__cause__ = exc
+            except Exception:
+                err = exc
+            slot.error = err
+            slot.event.set()
+
+    # graftlint: ok(lock-discipline): the _locked suffix is the contract — every caller holds _lock
+    def _ensure_connected_locked(self) -> None:
+        if self._shutdown:
+            raise RuntimeError(f"client to {self.host}:{self.port} is closed")
+        if self._closed:
+            if time.time() < self._next_redial:
+                raise ConnectionRefusedError(
+                    f"rank at {self.host}:{self.port} is down "
+                    "(redial cooldown)")
+            try:
+                self._connect(self.RECONNECT_TIMEOUT)
+            except OSError:
+                self._next_redial = time.time() + self.REDIAL_COOLDOWN
+                raise
+            self._closed = False
 
     def generic_fun(self, fname: str, args=(), kwargs=None, timeout: float = None,
                     deadline: float = None):
@@ -418,24 +627,97 @@ class Client:
             raise DeadlineExceeded(
                 f"deadline expired {time.time() - deadline:.3f}s before "
                 f"calling {fname}")
+        if not self._mux:
+            return self._call_serial(fname, args, kwargs, timeout, deadline)
+        # ---- ensure a live connection (lock held briefly; may redial) ----
+        with self._lock:
+            self._ensure_connected_locked()
+            epoch = self._epoch
+            sock = self.sock
+        # budget is computed HERE — after any redial wait — so the stamped
+        # value reflects what genuinely remains of the caller's deadline
+        budget = None
+        wait = timeout
+        rid = next(self._req_counter)
+        meta = {"req_id": rid}
+        if deadline is not None:
+            budget = deadline - time.time()
+            if budget <= 0:
+                raise DeadlineExceeded(
+                    f"deadline expired {-budget:.3f}s before sending {fname}")
+            meta["deadline_s"] = budget
+            # wait = budget + grace, so the server's structured shed
+            # response can win the race against our own timeout
+            w = budget + self.DEADLINE_GRACE
+            wait = w if wait is None else min(wait, w)
+        # pack OUTSIDE the lock (pickling runs in parallel across callers)
+        # and BEFORE touching the socket: a client-side pickling failure
+        # (unpicklable argument) must raise without tearing down a healthy
+        # connection — zero bytes have hit the wire.
+        parts = pack_frame(KIND_CALL, (fname, tuple(args), kwargs or {}, meta))
+        slot = _PendingCall(rid, fname)
+        t0 = time.perf_counter()
         with self._lock:
             if self._shutdown:
                 raise RuntimeError(f"client to {self.host}:{self.port} is closed")
-            if self._closed:
-                if time.time() < self._next_redial:
-                    raise ConnectionRefusedError(
-                        f"rank at {self.host}:{self.port} is down "
-                        "(redial cooldown)")
-                try:
-                    self._connect(self.RECONNECT_TIMEOUT)
-                except OSError:
-                    self._next_redial = time.time() + self.REDIAL_COOLDOWN
-                    raise
-                self._closed = False
-            # budget is computed HERE — after the lock wait and any redial —
-            # so the stamped value reflects what genuinely remains; a budget
-            # measured at entry could be stale by a whole in-flight call
-            # from another thread plus RECONNECT_TIMEOUT
+            if self._closed or epoch != self._epoch:
+                # the connection died between the liveness check and the
+                # send; transport-classified so retry/reroute handle it
+                raise ConnectionResetError(
+                    f"connection to {self.host}:{self.port} lost before "
+                    f"sending {fname}")
+            self._pending[rid] = slot
+            if len(self._pending) > self._inflight_peak:
+                self._inflight_peak = len(self._pending)
+            try:
+                _send_parts(self.sock, parts)
+            except BaseException as e:
+                # a torn mid-frame write desyncs the stream for EVERY
+                # in-flight call on it: fail them all and drop the socket
+                self._fail_locked(e)
+                raise
+        # ---- wait for this call's slot, outside any lock ----
+        if not slot.event.wait(wait):
+            exc = socket.timeout(
+                f"no response to {fname} within {wait:.3f}s")
+            with self._lock:
+                owned = self._pending.pop(rid, None) is not None
+                if owned:
+                    slot.error = exc
+                    # tear the whole window down only when there is
+                    # connection-level stall evidence — NOTHING has
+                    # arrived since this call was sent (hung/blackholed
+                    # rank; the next call redials, as with the serial
+                    # client) — or the peer answers untagged (legacy
+                    # server: abandoning a slot would make FIFO
+                    # attribution hand its late response to the NEXT
+                    # caller). A tagged peer that is merely slow for THIS
+                    # call keeps answering others: abandon just this slot
+                    # (the reader drops its late response by req_id)
+                    # instead of failing every unrelated in-flight call
+                    # with a collateral transport error.
+                    if epoch == self._epoch and (
+                            self._peer_tagged is not True
+                            or self._last_rx < slot.sent_t):
+                        self._fail_locked(exc)
+            if owned:
+                slot.event.set()
+            else:
+                slot.event.wait()  # a response raced the timeout: take it
+        if slot.error is not None:
+            raise slot.error
+        # record completed round trips only (parity with the serial path:
+        # a timeout/teardown must not land its wait ceiling in the p99)
+        self.stats.record("round_trip_s", time.perf_counter() - t0)
+        return self._interpret(slot.kind, slot.payload, fname)
+
+    def _call_serial(self, fname, args, kwargs, timeout, deadline):
+        """The pre-mux client: ``_lock`` held across the whole round trip,
+        frames only carry meta when a deadline is set (byte-compatible with
+        pre-deadline peers). Kept as the DFT_RPC_MUX=0 fallback and the
+        benchmark's A/B arm."""
+        with self._lock:
+            self._ensure_connected_locked()
             budget = None
             if deadline is not None:
                 budget = deadline - time.time()
@@ -443,22 +725,15 @@ class Client:
                     raise DeadlineExceeded(
                         f"deadline expired {-budget:.3f}s before sending "
                         f"{fname}")
-                # socket wait = budget + grace, so the server's structured
-                # shed response can win the race against our own timeout
                 wait = budget + self.DEADLINE_GRACE
                 timeout = wait if timeout is None else min(timeout, wait)
-            # pack BEFORE touching the socket: a client-side pickling failure
-            # (unpicklable argument) must raise without tearing down a
-            # healthy connection — zero bytes have hit the wire.
-            # The 4th payload element (frame meta) is only added when a
-            # deadline is set, so deadline-less frames stay byte-compatible
-            # with pre-deadline peers.
             payload = (fname, tuple(args), kwargs or {})
             if budget is not None:
                 payload = payload + ({"deadline_s": budget},)
             parts = pack_frame(KIND_CALL, payload)
             if timeout is not None:
                 self.sock.settimeout(timeout)
+            t0 = time.perf_counter()
             try:
                 _send_parts(self.sock, parts)
                 kind, payload = recv_frame(self.sock)
@@ -475,6 +750,10 @@ class Client:
             finally:
                 if timeout is not None and not self._closed:
                     self.sock.settimeout(None)
+        self.stats.record("round_trip_s", time.perf_counter() - t0)
+        return self._interpret(kind, payload, fname)
+
+    def _interpret(self, kind, payload, fname):
         if kind == KIND_RESULT:
             return payload
         if kind == KIND_ERROR:
@@ -489,6 +768,19 @@ class Client:
                 f"(queue {info.get('queue_depth', '?')}/"
                 f"{info.get('max_queue', '?')})", info)
         raise RuntimeError(f"unexpected frame kind {kind}")
+
+    def rpc_stats(self) -> dict:
+        """Per-stub observability: instantaneous/peak pipelining depth and
+        wire round-trip latency percentiles (docs/OPERATIONS.md)."""
+        with self._lock:
+            in_flight = len(self._pending)
+            peak = self._inflight_peak
+        return {
+            "mux": self._mux,
+            "in_flight": in_flight,
+            "in_flight_peak": peak,
+            "round_trip_s": self.stats.summary().get("round_trip_s", {}),
+        }
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -508,12 +800,30 @@ class Client:
             if self._shutdown:
                 return
             self._shutdown = True  # user-initiated: no auto-reconnect after this
-            if self._closed:
-                return
-            self._closed = True
-            try:
-                send_frame(self.sock, KIND_CLOSE, None)
-            except OSError:
-                pass
-            finally:
-                self.sock.close()
+            reader = self._reader
+            self._epoch += 1  # any live reader for this socket is now stale
+            stranded = list(self._pending.values())
+            self._pending.clear()
+            if not self._closed:
+                self._closed = True
+                try:
+                    send_frame(self.sock, KIND_CLOSE, None)
+                except OSError:
+                    pass
+                finally:
+                    try:
+                        # queued bytes (the CLOSE frame) still flush; the
+                        # shutdown wakes a demux reader blocked in recv
+                        self.sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.sock.close()
+        for slot in stranded:
+            slot.error = RuntimeError(
+                f"client to {self.host}:{self.port} closed with "
+                f"{slot.fname} in flight")
+            slot.event.set()
+        # clean demux shutdown: the closed socket wakes the reader, whose
+        # teardown no-ops against the bumped epoch and exits
+        if reader is not None and reader is not threading.current_thread():
+            reader.join(timeout=5.0)

@@ -26,6 +26,8 @@ import selectors
 import socket
 import threading
 import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -43,6 +45,17 @@ from distributed_faiss_tpu.utils.state import IndexState
 from distributed_faiss_tpu.utils.tracing import LatencyStats
 
 logger = logging.getLogger()
+
+
+def rpc_worker_count() -> int:
+    """Size of the per-server worker pool that runs mux-dispatched non-search
+    ops and writes scheduler completions back to their connections.
+    DFT_RPC_WORKERS overrides; the default is small — search (the hot path)
+    never occupies a worker for its compute, only for its response write."""
+    raw = os.environ.get("DFT_RPC_WORKERS")
+    if raw:
+        return max(1, int(raw))
+    return min(8, max(2, os.cpu_count() or 4))
 
 
 def setup_server_logging(level=logging.INFO) -> None:
@@ -80,6 +93,20 @@ class IndexServer:
             self.scheduler = SearchScheduler(
                 self._engine_search_batched, cfg,
                 name=f"search-batcher:r{rank}")
+        # request multiplexing: calls whose frame meta carries a req_id are
+        # dispatched without blocking the connection's reader (search → the
+        # scheduler's async completion path, everything else → this worker
+        # pool) and answered with req_id-tagged frames under a
+        # per-connection write lock — many calls in flight per connection,
+        # out-of-order completion. Legacy (no-req_id) frames keep the
+        # synchronous in-order path.
+        self._rpc_worker_count = rpc_worker_count()
+        self._rpc_workers = ThreadPoolExecutor(
+            max_workers=self._rpc_worker_count,
+            thread_name_prefix=f"rpc-worker:r{rank}")
+        self._mux_lock = threading.Lock()
+        self._mux_inflight = 0
+        self._mux_counters = {"mux_calls": 0, "legacy_calls": 0}
 
     # ------------------------------------------------------------ RPC surface
 
@@ -217,10 +244,22 @@ class IndexServer:
         ``"scheduler"`` key adds its queue/batch distributions (queue_wait_s,
         e2e_s, batch_requests, batch_rows, queue_depth) and admission
         counters (submitted, batches, shed_deadline, rejected_busy,
-        queued) — see docs/OPERATIONS.md#serving-scheduler."""
+        queued) — see docs/OPERATIONS.md#serving-scheduler. The ``"rpc"``
+        key carries the mux serving state (in-flight dispatches, mux vs
+        legacy call counts, worker-pool size; IndexClient merges each
+        stub's client-side view in under ``rpc.client``), and ``"engine"``
+        the per-index device-launch latency distributions — wire, queue,
+        and device time side by side."""
         out = self.perf.summary()
         if self.scheduler is not None:
             out["scheduler"] = self.scheduler.perf_stats()
+        with self._mux_lock:
+            out["rpc"] = {"in_flight": self._mux_inflight,
+                          **self._mux_counters}
+        out["rpc"]["workers"] = self._rpc_worker_count
+        with self.indexes_lock:
+            snapshot = list(self.indexes.items())
+        out["engine"] = {iid: idx.perf_stats() for iid, idx in snapshot}
         return out
 
     def ping(self) -> dict:
@@ -267,6 +306,11 @@ class IndexServer:
         # the save for the index locks
         if self.scheduler is not None:
             self.scheduler.stop()
+        # the scheduler's stop has already enqueued every stranded
+        # request's "stopping" response write; shutdown(wait=False) lets
+        # those drain on the worker threads without letting a dead peer's
+        # blocked send wedge this stop()
+        self._rpc_workers.shutdown(wait=False)
         # wait (bounded) for tracked async-training threads so a shutdown
         # can't orphan a half-trained index mid-save
         with self._threads_lock:
@@ -305,12 +349,21 @@ class IndexServer:
             except OSError:
                 break
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # bound zero-progress writes: mux responses ride a small
+            # shared worker pool, so a stalled peer must cost one worker
+            # at most SEND_TIMEOUT_S before its connection is dropped
+            rpc.bound_send_timeout(conn)
             _thread.start_new_thread(self._serve_connection, (conn, addr))
 
     def _serve_connection(self, conn: socket.socket, addr) -> None:
+        # one write lock per connection: mux responses are written by
+        # whichever thread completes the call (scheduler batcher via the
+        # worker pool, or a worker running a direct op), so frame writes
+        # must be serialized against each other and the sync path
+        wlock = threading.Lock()
         try:
             while True:
-                self._one_call(conn)
+                self._one_call(conn, wlock=wlock)
         except (rpc.ClientExit, EOFError):
             pass
         except OSError as e:
@@ -325,7 +378,8 @@ class IndexServer:
             except OSError:
                 pass
 
-    def _one_call(self, conn: socket.socket, eager_search: bool = False) -> None:
+    def _one_call(self, conn: socket.socket, eager_search: bool = False,
+                  wlock: Optional[threading.Lock] = None) -> None:
         kind, payload = rpc.recv_frame(conn)
         if kind == rpc.KIND_CLOSE:
             raise rpc.ClientExit("client closed")
@@ -333,12 +387,70 @@ class IndexServer:
             raise RuntimeError(f"unexpected frame kind {kind}")
         # 3-tuple (legacy) or 4-tuple with frame meta carrying the caller's
         # remaining deadline budget (relative seconds — clock-skew-safe;
-        # rebased onto this host's monotonic clock at decode)
+        # rebased onto this host's monotonic clock at decode) and, from mux
+        # clients, the req_id that pipelined dispatch tags responses with
         fname, args, kwargs = payload[:3]
         frame_meta = payload[3] if len(payload) > 3 else None
         deadline = None
-        if isinstance(frame_meta, dict) and frame_meta.get("deadline_s") is not None:
-            deadline = time.monotonic() + float(frame_meta["deadline_s"])
+        req_id = None
+        if isinstance(frame_meta, dict):
+            if frame_meta.get("deadline_s") is not None:
+                deadline = time.monotonic() + float(frame_meta["deadline_s"])
+            req_id = frame_meta.get("req_id")
+        if req_id is None:
+            with self._mux_lock:
+                self._mux_counters["legacy_calls"] += 1
+            self._call_sync(conn, fname, args, kwargs, deadline, eager_search)
+            return
+        # mux dispatch: the reader never blocks on the call — the response
+        # is written req_id-tagged under the connection's write lock by
+        # whoever completes it, so calls complete out of order
+        with self._mux_lock:
+            self._mux_counters["mux_calls"] += 1
+            self._mux_inflight += 1
+        t0 = time.perf_counter()
+        if fname == "search" and self.scheduler is not None:
+            self._dispatch_scheduled(conn, wlock, args, kwargs, deadline,
+                                     req_id, t0)
+        else:
+            try:
+                self._rpc_workers.submit(
+                    self._dispatch_direct, conn, wlock, fname, args, kwargs,
+                    req_id, t0)
+            except RuntimeError:  # pool already shut down (server stopping)
+                with self._mux_lock:
+                    self._mux_inflight -= 1
+                raise
+
+    def _classify_scheduler_reject(self, error):
+        """Map a scheduler admission/shed error to its structured BUSY
+        response: ``(perf_name, payload)`` — or None for non-scheduler
+        errors. The single source of truth for BOTH serving paths (legacy
+        sync and mux), so their BUSY payloads can never diverge."""
+        if isinstance(error, SchedulerBusy):
+            return "search:busy", {
+                "reason": "queue_full",
+                "queue_depth": error.queue_depth,
+                "max_queue": error.max_queue,
+            }
+        if isinstance(error, SchedulerStopped):
+            return "search:busy", {"reason": "stopping"}
+        if isinstance(error, DeadlineExpired):
+            return "search:shed", {"reason": "deadline"}
+        return None
+
+    def _call_sync(self, conn, fname, args, kwargs, deadline,
+                   eager_search) -> None:
+        """The legacy (no-req_id) path: serve the call on the reader thread
+        and answer untagged, in order — an old client against a mux server
+        works unchanged.
+
+        The response write happens OUTSIDE the handler chain: a write
+        failure (peer gone, or the SO_SNDTIMEO zero-progress bound firing
+        mid-frame) may leave a partial frame on the stream, after which
+        nothing further can be written safely — the OSError propagates and
+        the serving loop drops the connection, instead of appending an
+        ERROR frame to a torn stream."""
         t0 = time.perf_counter()
         try:
             fn = getattr(self, fname)
@@ -351,26 +463,25 @@ class IndexServer:
             else:
                 ret = fn(*args, **kwargs)
             self.perf.record(fname, time.perf_counter() - t0)
-            rpc.send_frame(conn, rpc.KIND_RESULT, ret)
-        except SchedulerBusy as e:
-            self.perf.record("search:busy", time.perf_counter() - t0)
-            rpc.send_frame(conn, rpc.KIND_BUSY, {
-                "reason": "queue_full",
-                "queue_depth": e.queue_depth,
-                "max_queue": e.max_queue,
-            })
-        except SchedulerStopped:
-            self.perf.record("search:busy", time.perf_counter() - t0)
-            rpc.send_frame(conn, rpc.KIND_BUSY, {"reason": "stopping"})
-        except DeadlineExpired:
-            self.perf.record("search:shed", time.perf_counter() - t0)
-            rpc.send_frame(conn, rpc.KIND_BUSY, {"reason": "deadline"})
+            kind, payload = rpc.KIND_RESULT, ret
+        except Exception as e:
+            busy = self._classify_scheduler_reject(e)
+            if busy is not None:
+                self.perf.record(busy[0], time.perf_counter() - t0)
+                kind, payload = rpc.KIND_BUSY, busy[1]
+            else:
+                tb = traceback.format_exc()
+                logger.error("exception in %s: %s", fname, tb)
+                kind, payload = rpc.KIND_ERROR, tb
+        try:
+            # pack before writing: an unpicklable RESULT must degrade to a
+            # structured error frame, not a torn connection
+            parts = rpc.pack_frame(kind, payload)
         except Exception:
-            import traceback
-
             tb = traceback.format_exc()
-            logger.error("exception in %s: %s", fname, tb)
-            rpc.send_frame(conn, rpc.KIND_ERROR, tb)
+            logger.error("could not serialize %s response: %s", fname, tb)
+            parts = rpc.pack_frame(rpc.KIND_ERROR, tb)
+        rpc._send_parts(conn, parts)
 
     def _scheduled_search(self, args, kwargs, deadline, eager=False):
         """Normalize a search RPC's args onto the scheduler's submit."""
@@ -382,11 +493,128 @@ class IndexServer:
             bool(vals.get("return_embeddings", False)), deadline=deadline,
             eager=eager)
 
+    # ------------------------------------------------------------ mux dispatch
+
+    def _dispatch_scheduled(self, conn, wlock, args, kwargs, deadline,
+                            req_id, t0) -> None:
+        """Hand a mux search to the scheduler without blocking the reader:
+        the scheduler already completes out of order via per-request
+        events, so its completion callback just enqueues the tagged
+        response write onto the worker pool (never socket I/O on the
+        batcher thread). No eager flush even on the selector loop — the
+        reader keeps pulling frames, so followers CAN arrive during the
+        wait window now, and coalescing them is the whole point."""
+
+        def done(result, error):
+            try:
+                self._rpc_workers.submit(self._finish_scheduled, conn, wlock,
+                                         req_id, result, error, t0)
+            except RuntimeError:
+                # pool already shut down (server stopping): the client's
+                # demux will fail the call when the connection drops
+                with self._mux_lock:
+                    self._mux_inflight -= 1
+
+        try:
+            vals = dict(zip(
+                ("index_id", "query_batch", "top_k", "return_embeddings"),
+                args))
+            vals.update(kwargs or {})
+            self.scheduler.submit_async(
+                vals["index_id"], vals["query_batch"], vals["top_k"],
+                bool(vals.get("return_embeddings", False)),
+                deadline=deadline, callback=done)
+        except Exception as e:
+            # admission rejected (BUSY/deadline/stopped) or bad args:
+            # answered synchronously — the request was never queued
+            self._finish_scheduled(conn, wlock, req_id, None, e, t0)
+
+    def _finish_scheduled(self, conn, wlock, req_id, result, error,
+                          t0) -> None:
+        if error is None:
+            self.perf.record("search", time.perf_counter() - t0)
+            self._send_mux_response(conn, wlock, rpc.KIND_RESULT, result,
+                                    req_id, "search")
+            return
+        busy = self._classify_scheduler_reject(error)
+        if busy is not None:
+            self.perf.record(busy[0], time.perf_counter() - t0)
+            self._send_mux_response(conn, wlock, rpc.KIND_BUSY, busy[1],
+                                    req_id, "search")
+            return
+        tb = "".join(traceback.format_exception(
+            type(error), error, error.__traceback__))
+        logger.error("exception in scheduled search: %s", tb)
+        self._send_mux_response(conn, wlock, rpc.KIND_ERROR, tb,
+                                req_id, "search")
+
+    def _dispatch_direct(self, conn, wlock, fname, args, kwargs, req_id,
+                         t0) -> None:
+        """Worker-pool target for mux non-search ops."""
+        try:
+            if fname.startswith("_"):
+                raise AttributeError(fname)
+            fn = getattr(self, fname)
+            ret = fn(*args, **(kwargs or {}))
+            self.perf.record(fname, time.perf_counter() - t0)
+            self._send_mux_response(conn, wlock, rpc.KIND_RESULT, ret,
+                                    req_id, fname)
+        except Exception:
+            tb = traceback.format_exc()
+            logger.error("exception in %s: %s", fname, tb)
+            self._send_mux_response(conn, wlock, rpc.KIND_ERROR, tb,
+                                    req_id, fname)
+
+    def _send_mux_response(self, conn, wlock, base_kind, payload, req_id,
+                           fname) -> None:
+        """Write one req_id-tagged response frame under the connection's
+        write lock. A write failure means the peer is gone — its demux has
+        already failed the call client-side, so only log. Called exactly
+        once per mux call (every dispatch path funnels here), which is
+        what keeps the in-flight gauge honest."""
+        try:
+            try:
+                parts = rpc.pack_tagged_response(base_kind, payload, req_id)
+            except Exception:
+                # unpicklable result: answer a structured error instead of
+                # leaving the caller waiting (zero bytes hit the wire yet)
+                tb = traceback.format_exc()
+                logger.error("could not serialize %s response: %s", fname, tb)
+                parts = rpc.pack_tagged_response(rpc.KIND_ERROR, tb, req_id)
+            with wlock:
+                rpc._send_parts(conn, parts)
+        except OSError as e:
+            logger.info("mux response write failed (%s req=%s): %s",
+                        fname, req_id, e)
+            # a failed/timed-out write may have left a partial frame on
+            # the stream — nothing further can be written safely. Shut the
+            # socket down so the connection reader wakes, drops it, and
+            # any still-queued writes for it fail fast with EPIPE.
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        except Exception:
+            logger.exception("mux response write failed (%s req=%s)",
+                             fname, req_id)
+        finally:
+            with self._mux_lock:
+                self._mux_inflight -= 1
+
     def start(self, port: int = rpc.DEFAULT_PORT, v6: bool = False) -> None:
         """Selector-based single-thread loop. The reference ships a broken
         version of this mode (its test is @skip'ed); ours blocks per ready
         connection on a full frame, which is correct (if lower-throughput
-        than the threaded mode) for well-behaved clients."""
+        than the threaded mode) for well-behaved clients.
+
+        Mux (req_id-tagged) calls get the non-blocking equivalent of the
+        threaded loop: the selector thread only decodes and dispatches
+        (scheduler / worker pool), and completion callbacks enqueue the
+        tagged response writes — so even this single-threaded loop holds a
+        whole in-flight window per connection and the scheduler can merge
+        it into one device batch. Legacy calls keep the eager inline path
+        (for a one-in-flight peer, waiting for followers that structurally
+        cannot arrive would be pure added latency)."""
         s = self._bind(port, v6)
         s.setblocking(True)
         sel = selectors.DefaultSelector()
@@ -403,16 +631,17 @@ class IndexServer:
                         conn, addr = s.accept()
                     except OSError:
                         continue
-                    sel.register(conn, selectors.EVENT_READ, data=addr)
+                    # per-connection (addr, write-lock) — the lock
+                    # serializes mux response writes from worker threads
+                    # against each other and the inline legacy path
+                    rpc.bound_send_timeout(conn)
+                    sel.register(conn, selectors.EVENT_READ,
+                                 data=(addr, threading.Lock()))
                 else:
                     conn = key.fileobj
+                    addr, wlock = key.data
                     try:
-                        # eager_search: this loop is single-threaded, so a
-                        # scheduled search blocks the only serving thread —
-                        # followers structurally cannot arrive during the
-                        # flush window; waiting for them would be pure
-                        # added latency. Admission control still applies.
-                        self._one_call(conn, eager_search=True)
+                        self._one_call(conn, eager_search=True, wlock=wlock)
                     except (rpc.ClientExit, EOFError, OSError):
                         sel.unregister(conn)
                         conn.close()
@@ -422,7 +651,7 @@ class IndexServer:
                         # loop keeps serving everyone else, matching the
                         # threaded mode's behavior in _serve_connection
                         logger.warning(
-                            "dropping connection from %s: %s", key.data, e)
+                            "dropping connection from %s: %s", addr, e)
                         sel.unregister(conn)
                         try:
                             conn.close()

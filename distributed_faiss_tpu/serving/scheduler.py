@@ -1,11 +1,12 @@
 """Deadline-aware micro-batching scheduler for the serving path.
 
-One ``SearchScheduler`` per server rank. Connection threads (or the
-selector loop) call ``submit``; a single named batcher thread drains the
-queue, coalesces compatible requests — same ``(index_id, top_k,
-return_embeddings, dim)`` — into one concatenated device batch, runs the
-engine's batched search entry once, and hands every caller its row
-slice. Two flush triggers: the pending compatible rows reach
+One ``SearchScheduler`` per server rank. Connection threads call
+``submit`` (blocking) or — for multiplexed RPC, where the connection
+reader must keep pulling frames — ``submit_async`` with a completion
+callback; a single named batcher thread drains the queue, coalesces
+compatible requests — same ``(index_id, top_k, return_embeddings,
+dim)`` — into one concatenated device batch, runs the engine's batched
+search entry once, and hands every caller its row slice. Two flush triggers: the pending compatible rows reach
 ``max_batch_rows``, or the oldest queued request has waited
 ``max_wait_ms``.
 
@@ -71,11 +72,12 @@ class SchedulerStopped(RuntimeError):
 
 class _Request:
     __slots__ = ("index_id", "q", "k", "return_embeddings", "deadline",
-                 "eager", "enqueue_t", "event", "result", "error")
+                 "eager", "enqueue_t", "event", "result", "error",
+                 "callback")
 
     def __init__(self, index_id: str, q: np.ndarray, k: int,
                  return_embeddings: bool, deadline: Optional[float],
-                 eager: bool = False):
+                 eager: bool = False, callback: Optional[Callable] = None):
         self.index_id = index_id
         self.q = q
         self.k = k
@@ -86,6 +88,10 @@ class _Request:
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        # async completion (the mux serving path): fired exactly once with
+        # (result, error) when the request completes, instead of a thread
+        # blocking on ``event``
+        self.callback = callback
 
     @property
     def key(self) -> Tuple:
@@ -147,15 +153,37 @@ class SearchScheduler:
         is ready. ``deadline`` is an absolute ``time.monotonic()`` instant;
         expired requests never reach the device. ``eager`` skips the
         max-wait window when this request heads the queue — for callers
-        that cannot overlap (the single-threaded selector loop, where
-        waiting for followers that structurally cannot arrive would add
-        max_wait_ms of pure latency); admission control and coalescing
-        with already-queued requests still apply."""
+        that cannot overlap (a legacy one-in-flight peer on the
+        single-threaded selector loop, where waiting for followers that
+        structurally cannot arrive would add max_wait_ms of pure latency);
+        admission control and coalescing with already-queued requests
+        still apply."""
+        req = self.submit_async(index_id, query_batch, top_k,
+                                return_embeddings, deadline=deadline,
+                                eager=eager)
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        self.stats.record("e2e_s", time.monotonic() - req.enqueue_t)
+        return req.result
+
+    def submit_async(self, index_id: str, query_batch: np.ndarray,
+                     top_k: int, return_embeddings: bool = False,
+                     deadline: Optional[float] = None, eager: bool = False,
+                     callback: Optional[Callable] = None) -> _Request:
+        """Admission-checked enqueue that returns immediately (the mux
+        serving loops' entry: the connection reader must keep pulling
+        frames). ``callback(result, error)`` fires exactly once — on the
+        batcher thread — when the request completes; exactly one of the
+        two is non-None. Admission failures (SchedulerBusy /
+        DeadlineExpired / SchedulerStopped) raise synchronously in the
+        caller: the request was never queued and the callback will not
+        fire."""
         q = np.asarray(query_batch, np.float32)
         if q.ndim != 2:
             raise ValueError(f"query batch must be 2-D, got shape {q.shape}")
         req = _Request(index_id, q, int(top_k), bool(return_embeddings),
-                       deadline, eager=eager)
+                       deadline, eager=eager, callback=callback)
         with self._cond:
             if self._stopping:
                 raise SchedulerStopped("scheduler is stopped")
@@ -169,11 +197,30 @@ class SearchScheduler:
             self._counters["submitted"] += 1
             self._queue.append(req)
             self._cond.notify_all()
-        req.event.wait()
-        if req.error is not None:
-            raise req.error
-        self.stats.record("e2e_s", time.monotonic() - req.enqueue_t)
-        return req.result
+        return req
+
+    def _finish(self, req: _Request) -> None:
+        """Publish a request's outcome exactly once: wake a blocked
+        ``submit`` and fire the async completion callback (if any). Every
+        completion path funnels here, so a request can never complete
+        twice (the event doubles as the fired-flag) or complete with
+        neither result nor error."""
+        if req.event.is_set():
+            return
+        if req.error is None and req.result is None:
+            req.error = RuntimeError("scheduled search aborted")
+        req.event.set()
+        if req.callback is not None:
+            if req.error is None:
+                # successes only — parity with the blocking submit(), so
+                # e2e_s stays comparable between mux and legacy serving
+                # (shed/busy failures would otherwise pollute the p99
+                # with their queue-wait ceilings)
+                self.stats.record("e2e_s", time.monotonic() - req.enqueue_t)
+            try:
+                req.callback(req.result, req.error)
+            except Exception:
+                logger.exception("scheduler completion callback failed")
 
     # ----------------------------------------------------------- batcher side
 
@@ -192,7 +239,7 @@ class SearchScheduler:
                     stranded, self._queue = self._queue, []
                 for r in stranded:
                     r.error = RuntimeError("scheduler internal error")
-                    r.event.set()
+                    self._finish(r)
                 time.sleep(0.05)  # never spin hot on a persistent failure
                 continue
             if batch is None:
@@ -202,10 +249,7 @@ class SearchScheduler:
             except BaseException:  # the loop must survive any launch failure
                 logger.exception("scheduler batch failed")
                 for r in batch:
-                    if not r.event.is_set():
-                        if r.error is None and r.result is None:
-                            r.error = RuntimeError("scheduled search aborted")
-                        r.event.set()
+                    self._finish(r)
 
     def _next_batch(self) -> Optional[List[_Request]]:
         """Block until a flush trigger fires; pop and return one batch of
@@ -253,7 +297,7 @@ class SearchScheduler:
                 r.error = DeadlineExpired(
                     "deadline expired while queued "
                     f"(waited {now - r.enqueue_t:.3f}s)")
-                r.event.set()
+                self._finish(r)
                 continue
             self.stats.record("queue_wait_s", now - r.enqueue_t)
             live.append(r)
@@ -293,9 +337,7 @@ class SearchScheduler:
                 r.error = err
         finally:
             for r in live:
-                if r.error is None and r.result is None:
-                    r.error = RuntimeError("scheduled search aborted")
-                r.event.set()
+                self._finish(r)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -308,7 +350,7 @@ class SearchScheduler:
             self._cond.notify_all()
         for r in stranded:
             r.error = SchedulerStopped("scheduler stopped with request queued")
-            r.event.set()
+            self._finish(r)
         self._thread.join(timeout=10.0)
         if self._thread.is_alive():  # pragma: no cover - launch wedged in device
             logger.warning("scheduler batcher thread did not exit in 10s")

@@ -79,12 +79,15 @@ class EchoServer:
 
 
 def _serve(server_obj, sock):
+    """Legacy (pre-mux) serve loop: one frame at a time, untagged in-order
+    responses, meta element (deadline_s/req_id) ignored — the interop
+    shape a mux client must degrade against (FIFO demux attribution)."""
     try:
         while True:
             kind, payload = rpc.recv_frame(sock)
             if kind == rpc.KIND_CLOSE:
                 break
-            fname, args, kwargs = payload
+            fname, args, kwargs = payload[:3]
             try:
                 ret = getattr(server_obj, fname)(*args, **kwargs)
                 rpc.send_frame(sock, rpc.KIND_RESULT, ret)
@@ -496,17 +499,31 @@ def test_deadline_stamped_as_relative_budget_in_frame():
         (rpc.KIND_RESULT, "ok"),
     ])
     c = rpc.Client(0, "localhost", srv.port)
-    # no deadline -> legacy 3-tuple frame (wire-compatible with old peers)
+    # mux frames always carry the meta element (req_id); deadline_s joins
+    # it only when a deadline is set. A SERIAL (DFT_RPC_MUX=0) client still
+    # sends legacy 3-tuple frames without a deadline — checked below.
     assert c.generic_fun("ping", ()) == "ok"
     assert c.generic_fun("ping", (), deadline=time.time() + 5.0) == "ok"
     deadline = time.time() + 5
     while len(srv.frames) < 2 and time.time() < deadline:
         time.sleep(0.01)
-    assert len(srv.frames[0]) == 3
+    assert len(srv.frames[0]) == 4
+    assert srv.frames[0][3].keys() == {"req_id"}
     assert len(srv.frames[1]) == 4
+    assert srv.frames[1][3]["req_id"] != srv.frames[0][3]["req_id"]
     budget = srv.frames[1][3]["deadline_s"]
     assert 0.0 < budget <= 5.0  # RELATIVE seconds, clock-skew-safe
     c.close()
+
+    srv2 = _RecordingServer([(rpc.KIND_RESULT, "ok")])
+    serial = rpc.Client(0, "localhost", srv2.port, mux=False)
+    assert serial.generic_fun("ping", ()) == "ok"
+    deadline = time.time() + 5
+    while not srv2.frames and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(srv2.frames[0]) == 3  # no-meta legacy frame
+    serial.close()
+    srv2.close()
     srv.close()
 
 

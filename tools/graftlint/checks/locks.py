@@ -49,6 +49,19 @@ PINS = {
     ("SearchScheduler", "_counters"): "_cond",
     ("SearchScheduler", "_stopping"): "_cond",
     ("IndexServer", "_train_threads"): "_threads_lock",
+    # RPC multiplexing thread state (parallel/rpc.py, parallel/server.py):
+    # the client's in-flight slot table and connection generation are
+    # shared between callers, the demux reader, and teardown; the server's
+    # in-flight gauge/counters between connection readers and the worker
+    # pool's response writers
+    ("Client", "_pending"): "_lock",
+    ("Client", "_closed"): "_lock",
+    ("Client", "_epoch"): "_lock",
+    ("Client", "_inflight_peak"): "_lock",
+    ("Client", "_last_rx"): "_lock",
+    ("Client", "_peer_tagged"): "_lock",
+    ("IndexServer", "_mux_inflight"): "_mux_lock",
+    ("IndexServer", "_mux_counters"): "_mux_lock",
 }
 
 _SKIP_METHODS = frozenset({"__init__", "__new__", "__del__"})
