@@ -33,6 +33,18 @@ loopback.
                 batch_requests the scheduler observed — >1 is impossible
                 in the off arm)
 
+plus the mesh-sharded serving A/B (``--mesh``, off by default): a
+mesh-backed engine (flat corpus sharded over a virtual 8-device CPU
+mesh, forced via XLA_FLAGS before jax imports) served per-request vs
+through the scheduler:
+
+  mesh_scheduler_off — one device launch per request on the mesh
+  mesh_scheduler_on  — merged windows through serving.SearchScheduler;
+                       the row reports the engine's new launch counters
+                       (launches_per_window_max MUST be exactly 1.0:
+                       one pjit launch per merged batch, results leave
+                       the device once per window)
+
 The scheduler AND mux arms cross-check RESULT IDENTITY: every client's
 results must be byte-identical to direct/sequential serving (the batch
 or connection a row rides must not change its answer).
@@ -281,6 +293,65 @@ def run_mux_arms(idx, queries, k, arm, inflight, reps, backend,
     return rows
 
 
+def run_mesh_arms(arm, n_threads=8, batch=32, reps=4, k=10):
+    """Mesh-sharded serving A/B: per-request launches vs scheduler-merged
+    windows against ONE mesh-backed engine rank. Returns JSON-ready rows
+    carrying the launch counters (ISSUE 6 acceptance: exactly one device
+    launch per merged window, identical results across arms)."""
+    import jax
+
+    from distributed_faiss_tpu.engine import Index
+    from distributed_faiss_tpu.parallel.mesh import ShardedFlatIndex
+    from distributed_faiss_tpu.utils.config import IndexCfg
+    from distributed_faiss_tpu.utils.state import IndexState
+
+    small = os.environ.get("BENCH_SMALL") == "1"
+    n, d = (50_000 if small else 200_000), 64
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    cfg = IndexCfg(index_builder_type="flat", dim=d, metric="l2",
+                   train_num=1024, mesh_shards=True)
+    idx = Index(cfg)
+    idx.add_batch(x, list(range(n)), train_async_if_triggered=False)
+    idx.train()
+    deadline = time.time() + 1800
+    while (idx.get_state() != IndexState.TRAINED
+           or idx.get_idx_data_num()[0] > 0):
+        assert time.time() < deadline, "mesh train/drain timed out"
+        time.sleep(0.2)
+    assert isinstance(idx.tpu_index, ShardedFlatIndex)
+    ndev = idx.tpu_index.nshards
+
+    queries = [rng.standard_normal((batch, d)).astype(np.float32)
+               for _ in range(n_threads)]
+    idx.search(queries[0], k)  # warm the jit cache
+    # warm the merged-window row buckets the scheduler can produce
+    warm = np.concatenate(queries, axis=0)
+    for rows in range(batch, batch * n_threads + 1, batch):
+        idx.search_batched(warm[:rows], k)
+
+    arms = scheduler_arms(idx, arm)
+    identical = check_identity(idx, arms, queries, k)
+    backend = jax.devices()[0].platform
+    out = []
+    for name, search in arms:
+        idx.perf.reset()
+        qps, p99 = run_clients(search, queries, n_threads, reps, k)
+        s = idx.perf.summary()
+        launches = s.get("device_launches", {})
+        out.append({
+            "case": f"mesh_{name}", "backend": backend,
+            "mesh_devices": ndev, "threads": n_threads, "batch": batch,
+            "qps": round(qps, 1), "p99_ms": round(p99, 2),
+            "identical": identical[name],
+            "launches_per_window_max": launches.get("max_s", 0.0),
+            "windows": launches.get("count", 0),
+            "rows_per_launch_max":
+                s.get("rows_per_launch", {}).get("max_s", 0.0),
+        })
+    return out
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -301,9 +372,30 @@ def main():
         help="rows per request in the mux arms (default 4: user-sized "
              "requests riding the per-launch dispatch floor)")
     parser.add_argument(
+        "--mesh", choices=("on", "off", "both", "none"), default="none",
+        help="mesh-sharded serving A/B arm(s) on a virtual 8-device CPU "
+             "mesh (forces XLA_FLAGS before jax imports; default: none — "
+             "run with --mesh both for the one-launch-per-window check)")
+    parser.add_argument(
         "--modes", default="percall,natural,window",
         help="comma list of legacy batcher modes to run ('' = skip)")
     args = parser.parse_args()
+
+    if args.mesh != "none":
+        # must land before the first jax import anywhere in this process
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        if (any(args.modes.split(",")) or args.scheduler != "none"
+                or args.mux != "none"):
+            # the flag is process-wide: every arm in this invocation runs
+            # on the forced topology, so its rows are not comparable to
+            # single-device baseline rows (RESULTS.md r6-r8)
+            print("WARNING: --mesh forces an 8-virtual-device host platform "
+                  "for the whole process; run the scheduler/mux/legacy arms "
+                  "in a separate invocation for baseline-comparable rows",
+                  file=sys.stderr, flush=True)
 
     import jax
 
@@ -315,31 +407,33 @@ def main():
     n = 50_000 if small else 500_000
     d, k = 128, 10
     n_threads, batch, reps = 8, 32, 4 if small else 8
-
-    rng = np.random.default_rng(0)
-    centers = rng.standard_normal((256, d)).astype(np.float32) * 4.0
-    a = rng.integers(0, 256, n)
-    x = (centers[a] + rng.standard_normal((n, d))).astype(np.float32)
-
-    cfg = IndexCfg(index_builder_type="ivfsq", dim=d, metric="l2",
-                   train_num=min(n, 100_000), centroids=256, nprobe=4)
-    idx = Index(cfg)
-    idx.add_batch(x, list(range(n)), train_async_if_triggered=False)
-    idx.train()
-    deadline = time.time() + 1800
-    while idx.get_state() != IndexState.TRAINED:
-        assert time.time() < deadline, "train timed out"
-        time.sleep(0.5)
-
-    queries = [
-        (centers[rng.integers(0, 256, batch)]
-         + rng.standard_normal((batch, d))).astype(np.float32)
-        for _ in range(n_threads)
-    ]
-    idx.search(queries[0], k)  # warm the jit cache
-
     backend = jax.devices()[0].platform
+
     modes = [m for m in args.modes.split(",") if m]
+    need_single = bool(modes) or args.scheduler != "none" or args.mux != "none"
+    if need_single:
+        rng = np.random.default_rng(0)
+        centers = rng.standard_normal((256, d)).astype(np.float32) * 4.0
+        a = rng.integers(0, 256, n)
+        x = (centers[a] + rng.standard_normal((n, d))).astype(np.float32)
+
+        cfg = IndexCfg(index_builder_type="ivfsq", dim=d, metric="l2",
+                       train_num=min(n, 100_000), centroids=256, nprobe=4)
+        idx = Index(cfg)
+        idx.add_batch(x, list(range(n)), train_async_if_triggered=False)
+        idx.train()
+        deadline = time.time() + 1800
+        while idx.get_state() != IndexState.TRAINED:
+            assert time.time() < deadline, "train timed out"
+            time.sleep(0.5)
+
+        queries = [
+            (centers[rng.integers(0, 256, batch)]
+             + rng.standard_normal((batch, d))).astype(np.float32)
+            for _ in range(n_threads)
+        ]
+        idx.search(queries[0], k)  # warm the jit cache
+
     for mode in modes:
         qps, p99 = run_clients(make_search(idx, mode), queries,
                                n_threads, reps, k)
@@ -375,6 +469,18 @@ def main():
             # reached the scheduler as one merged batch (impossible with
             # the serial stub)
             assert by_case["rpc_mux_on"]["merged_batch_max"] > 1, by_case
+
+    if args.mesh != "none":
+        rows = run_mesh_arms(args.mesh, n_threads=n_threads, batch=batch,
+                             reps=reps, k=k)
+        for row in rows:
+            print(json.dumps(row), flush=True)
+        assert all(r["identical"] for r in rows), \
+            f"mesh results diverged from direct launches: {rows}"
+        for r in rows:
+            # the ISSUE 6 acceptance: every merged window crossed to the
+            # mesh as exactly ONE pjit launch
+            assert r["launches_per_window_max"] == 1.0, r
 
 
 if __name__ == "__main__":

@@ -34,6 +34,7 @@ _LAZY = {
     "BusyError": ("distributed_faiss_tpu.parallel.rpc", "BusyError"),
     "DeadlineExceeded": ("distributed_faiss_tpu.parallel.rpc", "DeadlineExceeded"),
     "SchedulerCfg": ("distributed_faiss_tpu.utils.config", "SchedulerCfg"),
+    "MeshCfg": ("distributed_faiss_tpu.utils.config", "MeshCfg"),
     "SearchScheduler": ("distributed_faiss_tpu.serving.scheduler", "SearchScheduler"),
 }
 

@@ -366,14 +366,31 @@ class Index:
     def _device_search(self, query_batch: np.ndarray, top_k: int):
         """The locked device launch behind the batcher: one in-flight
         search per index (reference rationale at index.py:246-252; the
-        lock also serializes against add/growth)."""
+        lock also serializes against add/growth).
+
+        Routes through the model's already-batched entry
+        (``TpuIndex.search_batched``): for mesh-backed indexes that is the
+        one-pjit-launch path — the whole merged window reaches the chips as
+        a single device program with an on-mesh top-k reduce, and results
+        leave the device exactly once (parallel/mesh.py). Models exposing a
+        ``launches`` dispatch counter get it diffed around the call into
+        ``device_launches`` (dispatches this window took — 1.0 on the mesh
+        path) and ``rows_per_launch`` (merged-window occupancy per
+        dispatch), both served through ``perf_stats``."""
         with self.index_lock:
             if self.state != IndexState.TRAINED:
                 raise RuntimeError(f"Server index is not trained. state: {self.state}")
+            launches0 = getattr(self.tpu_index, "launches", None)
             t0 = time.perf_counter()
-            out = self.tpu_index.search(query_batch, top_k)
+            out = self.tpu_index.search_batched(query_batch, top_k)
             self.perf.record("device_search_s", time.perf_counter() - t0)
             self.perf.record("device_search_rows", float(query_batch.shape[0]))
+            if launches0 is not None:
+                launches = self.tpu_index.launches - launches0
+                self.perf.record("device_launches", float(launches))
+                if launches > 0:
+                    self.perf.record(
+                        "rows_per_launch", query_batch.shape[0] / launches)
             return out
 
     def search(
@@ -400,7 +417,11 @@ class Index:
         coalesced concurrent callers into ``query_batch``, and it calls
         from a single batcher thread, so routing through the natural
         batcher again would only add leader/follower bookkeeping to every
-        launch."""
+        launch. For a mesh-backed index the locked launch is the
+        one-pjit-launch path (``TpuIndex.search_batched``): the merged
+        window crosses to the chips as a single device program and the
+        engine's ``device_launches``/``rows_per_launch`` perf rows record
+        the contract (see ``_device_search``)."""
         query_batch = np.asarray(query_batch, np.float32)
         if not return_embeddings:
             scores, indexes = self._device_search(query_batch, top_k)
@@ -462,9 +483,13 @@ class Index:
     def perf_stats(self) -> dict:
         """Per-index device-launch latency summary: ``device_search_s``
         (wall time of each locked launch), ``device_search_rows`` (rows per
-        launch — the "_s" suffix on summary keys is historical; these are
-        counts), ``reconstruct_search_s`` (search+reconstruct launches).
-        Served through IndexServer.get_perf_stats under ``"engine"``."""
+        merged window — the "_s" suffix on summary keys is historical;
+        these are counts), ``reconstruct_search_s`` (search+reconstruct
+        launches); for mesh-backed indexes additionally
+        ``device_launches`` (device dispatches per merged window — the
+        one-launch serving contract means max_s == 1.0) and
+        ``rows_per_launch`` (window occupancy per dispatch). Served
+        through IndexServer.get_perf_stats under ``"engine"``."""
         return self.perf.summary()
 
     def get_centroids(self):

@@ -295,6 +295,16 @@ class TpuIndex:
     def search(self, q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError
 
+    def search_batched(self, q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Already-merged serving entry (the scheduler's launch target via
+        ``engine.Index.search_batched``): ``q`` is one coalesced window of
+        concurrent callers' rows. The default is plain ``search``; mesh-
+        backed models whose plain path would otherwise loop host-side
+        guarantee ONE device launch per call here (parallel/mesh.py), and
+        models exposing a ``launches`` counter let the engine report
+        launches-per-window (``Index.perf``)."""
+        return self.search(q, k)
+
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
         """Return (approximate) stored vectors for ids (FAISS
         search_and_reconstruct parity, reference index.py:255-257)."""
@@ -361,3 +371,50 @@ def query_blocks(q: np.ndarray, block: int = 256):
         chunk = q[s : s + block]
         bucket = distance.bucket_size(chunk.shape[0])
         yield s, chunk.shape[0], distance.pad_rows(chunk, bucket)
+
+
+def blocked_search(q: np.ndarray, k: int, metric: str, fn, block: int = 256,
+                   fused_fn=None):
+    """THE blocked search driver (shared by the IVF family and the mesh
+    indexes — one implementation so the bucketing/padding policy cannot
+    drift between them).
+
+    Default: one device launch per query block (``fn`` over a padded
+    (bucket, d) block). When the batch spans multiple blocks and the
+    caller supplies ``fused_fn`` (a callable over (nblocks, block, d)
+    stacked queries), the whole batch runs in ONE launch — on the
+    launch-bound relay that saves (nblocks-1) * ~66 ms per search call.
+    The trailing block is padded to full width inside the fused path
+    (extra compute only, free in the launch-bound regime); jit variants
+    are keyed on nblocks, which is bucketed to powers of two so a
+    variable-batch serving workload compiles O(log max_batch) fused
+    variants (each sharded variant is a multi-second compile) instead of
+    one per distinct batch size — offline/bench callers with a stable
+    batch size still compile once.
+
+    Memory cliff (ADVICE r4): the pow2 bucket can pad the fused batch up
+    to ~2x (33 blocks -> 64), doubling the stacked (nblocks, block, d)
+    query input and (nblocks*block, k') output arrays for that launch.
+    The per-block score/gather transients — the dominant footprint,
+    bounded by ``pick_query_block``'s budget — are NOT inflated
+    (``lax.map`` runs blocks sequentially), so the cliff is a few MB of
+    query/output padding, not a doubled working set; callers pinning
+    their own batch sizes can stay at power-of-two multiples of the
+    block to avoid even that.
+    """
+    q = np.asarray(q, np.float32)
+    nq = q.shape[0]
+    if fused_fn is not None and nq > block:
+        nblocks = _next_pow2(-(-nq // block), 1)
+        qp = np.pad(q, ((0, nblocks * block - nq), (0, 0)))
+        vals, ids = fused_fn(jnp.asarray(qp.reshape(nblocks, block, -1)))
+        out_s = np.asarray(vals).reshape(nblocks * block, -1)[:nq]
+        out_i = np.asarray(ids).reshape(nblocks * block, -1)[:nq].astype(np.int64)
+        return finalize_results(out_s, out_i, metric)
+    out_s = np.empty((nq, k), np.float32)
+    out_i = np.empty((nq, k), np.int64)
+    for s, n, chunk in query_blocks(q, block):
+        vals, ids = fn(jnp.asarray(chunk))
+        out_s[s : s + n] = np.asarray(vals)[:n]
+        out_i[s : s + n] = np.asarray(ids)[:n]
+    return finalize_results(out_s, out_i, metric)

@@ -47,12 +47,28 @@ def _kmeans_iters(cfg: IndexCfg) -> int:
 
 
 def _mesh(cfg: IndexCfg):
-    """Resolve the optional device mesh from cfg.extra['mesh_devices']
-    (lazy import: only mesh-backed builders pay for jax.sharding)."""
+    """Resolve the optional device mesh: cfg.extra['mesh_devices'] wins
+    (an explicit 0 pins ALL local devices, overriding the host env), else
+    None — the index constructors then call make_mesh(None), which applies
+    the per-host DFT_MESH_DEVICES default (lazy import: only mesh-backed
+    builders pay for jax.sharding)."""
     from distributed_faiss_tpu.parallel.mesh import make_mesh
 
     n_dev = cfg.extra.get("mesh_devices")
-    return make_mesh(int(n_dev)) if n_dev else None
+    if n_dev is None:
+        return None  # make_mesh(None) downstream applies the env default
+    return make_mesh(int(n_dev))
+
+
+def _probe_routing(cfg: IndexCfg) -> bool:
+    """Sharded-IVF serving mode: cfg.extra['probe_routing'] wins, else the
+    per-host DFT_MESH_MODE default ('routed' -> True)."""
+    from distributed_faiss_tpu.utils.config import MeshCfg
+
+    pr = cfg.extra.get("probe_routing")
+    if pr is None:
+        return MeshCfg.from_env().mode == "routed"
+    return bool(pr)
 
 
 def _build_flat(cfg: IndexCfg):
@@ -61,7 +77,7 @@ def _build_flat(cfg: IndexCfg):
         from distributed_faiss_tpu.parallel.mesh import ShardedFlatIndex
 
         return ShardedFlatIndex(cfg.dim, cfg.get_metric(), mesh=_mesh(cfg))
-    if cfg.extra.get("mesh_devices"):
+    if cfg.extra.get("mesh_devices") is not None:  # 0 is an explicit pin too
         logging.getLogger().warning(
             "mesh_devices is set but mesh_shards is not: building a "
             "single-device flat index (set mesh_shards=True to shard)"
@@ -115,14 +131,16 @@ def _build_knnlm(cfg: IndexCfg):
         return ShardedIVFPQIndex(
             cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
             mesh=_mesh(cfg), kmeans_iters=_kmeans_iters(cfg),
-            probe_routing=bool(cfg.extra.get("probe_routing")),
+            probe_routing=_probe_routing(cfg),
             use_pallas=bool(cfg.extra.get("pallas_adc", False)),
             refine_k_factor=int(cfg.extra.get("refine_k_factor", 0)),
             adc_lut_bf16=bool(cfg.extra.get("adc_lut_bf16", False)),
         )
-    if cfg.extra.get("probe_routing"):
+    if _probe_routing(cfg):
         logging.getLogger().warning(
-            "probe_routing requires shard_lists=True on the knnlm builder; ignored"
+            "probe_routing (cfg.extra or DFT_MESH_MODE=routed) requires "
+            "shard_lists=True on the knnlm builder; ignored — building "
+            "the single-device scan"
         )
     return IVFPQIndex(cfg.dim, _centroids(cfg), m=m, nbits=nbits, metric=cfg.get_metric(),
                       kmeans_iters=_kmeans_iters(cfg),
@@ -174,10 +192,12 @@ def _build_ivf_tpu(cfg: IndexCfg):
                     "serves this index unrefined", knob)
         return ShardedIVFFlatIndex(cfg.dim, _centroids(cfg), cfg.get_metric(),
                                    mesh=mesh, kmeans_iters=_kmeans_iters(cfg),
-                                   probe_routing=bool(cfg.extra.get("probe_routing")))
-    if cfg.extra.get("probe_routing"):
+                                   probe_routing=_probe_routing(cfg))
+    if _probe_routing(cfg):
         logging.getLogger().warning(
-            "probe_routing requires shard_lists=True on the ivf_tpu builder; ignored"
+            "probe_routing (cfg.extra or DFT_MESH_MODE=routed) requires "
+            "shard_lists=True on the ivf_tpu builder; ignored — building "
+            "the single-device scan"
         )
     return IvfTpuIndex(cfg.dim, _centroids(cfg), cfg.get_metric(), "f32",
                        mesh=mesh, kmeans_iters=_kmeans_iters(cfg),

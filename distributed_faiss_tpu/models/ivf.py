@@ -410,46 +410,12 @@ class _IVFBase(base.TpuIndex):
 
     def _search_blocks(self, q: np.ndarray, k: int, fn, block: int = 256,
                        fused_fn=None):
-        """Blocked search driver.
-
-        Default: one device launch per query block (``fn``). When the batch
-        spans multiple blocks and the caller supplies ``fused_fn`` (a
-        callable over (nblocks, block, d) stacked queries), the whole batch
-        runs in ONE launch — on the launch-bound relay that saves
-        (nblocks-1) * ~66 ms per search call. The trailing block is padded
-        to full width inside the fused path (extra compute only, free in
-        the launch-bound regime); jit variants are keyed on nblocks, which
-        is bucketed to powers of two so a variable-batch serving workload
-        compiles O(log max_batch) fused variants (each sharded variant is a
-        multi-second compile) instead of one per distinct batch size —
-        offline/bench callers with a stable batch size still compile once.
-
-        Memory cliff (ADVICE r4): the pow2 bucket can pad the fused batch
-        up to ~2x (33 blocks -> 64), doubling the stacked (nblocks, block,
-        d) query input and (nblocks*block, k') output arrays for that
-        launch. The per-block score/gather transients — the dominant
-        footprint, bounded by ``pick_query_block``'s budget — are NOT
-        inflated (``lax.map`` runs blocks sequentially), so the cliff is
-        a few MB of query/output padding, not a doubled working set;
-        callers pinning their own batch sizes can stay at power-of-two
-        multiples of the block to avoid even that.
-        """
-        q = np.asarray(q, np.float32)
-        nq = q.shape[0]
-        if fused_fn is not None and nq > block:
-            nblocks = base._next_pow2(-(-nq // block), 1)
-            qp = np.pad(q, ((0, nblocks * block - nq), (0, 0)))
-            vals, ids = fused_fn(jnp.asarray(qp.reshape(nblocks, block, -1)))
-            out_s = np.asarray(vals).reshape(nblocks * block, -1)[:nq]
-            out_i = np.asarray(ids).reshape(nblocks * block, -1)[:nq].astype(np.int64)
-            return base.finalize_results(out_s, out_i, self.metric)
-        out_s = np.empty((nq, k), np.float32)
-        out_i = np.empty((nq, k), np.int64)
-        for s, n, chunk in base.query_blocks(q, block):
-            vals, ids = fn(jnp.asarray(chunk))
-            out_s[s : s + n] = np.asarray(vals)[:n]
-            out_i[s : s + n] = np.asarray(ids)[:n]
-        return base.finalize_results(out_s, out_i, self.metric)
+        """Blocked search driver — see ``models.base.blocked_search`` (the
+        single shared implementation: one launch per block by default;
+        with ``fused_fn`` a multi-block batch runs in ONE lax.map launch,
+        with the pow2-bucketing and memory-cliff rationale documented
+        there)."""
+        return base.blocked_search(q, k, self.metric, fn, block, fused_fn)
 
     def _empty_results(self, nq: int, k: int):
         d = np.full((nq, k), np.inf if self.metric == "l2" else -np.inf, np.float32)

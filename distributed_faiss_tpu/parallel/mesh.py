@@ -29,6 +29,22 @@ Components:
 - ``ShardedIVFPQIndex``     — IVF-PQ over sharded code lists (per-chip
                               residual-LUT ADC, ICI merge)
 
+Serving contract (ISSUE 6): in the default masked mode every sharded
+index's ``search`` issues ONE pjit launch per call — single block direct,
+multi-block through the fused ``lax.map`` entries (``_sharded_knn_fused``
+and the IVF ``*_fused`` programs) — with the top-k reduce on-mesh, so a
+scheduler-merged window (engine.search_batched) crosses the host/device
+boundary exactly once in each direction. Probe-routed mode has no fused
+multi-block entry (its pair buckets scale with the block, so stacking
+blocks would square the transient): a merged window larger than the
+routed block budget (``_routed_block_size``) legitimately costs one
+launch per block, plus bucket-growth relaunches under skewed ownership.
+Each index carries a ``launches`` dispatch counter (``_counted``; the PQ
+pallas degrade ladder counts each real attempt) that the engine diffs
+into its ``device_launches`` / ``rows_per_launch`` perf rows — so the
+counter tells the truth in every mode, and the ==1.0 contract is the
+masked mode's.
+
 Tests exercise all of this on a virtual 8-device CPU mesh
 (tests/conftest.py); the driver's dryrun_multichip does the same through
 __graft_entry__.py.
@@ -73,8 +89,21 @@ AXIS = "shard"
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1D device mesh over the local chips.
+
+    ``n_devices=None`` applies the per-host ``DFT_MESH_DEVICES`` default
+    (utils.config.MeshCfg) — so snapshot restores (``from_state_dict``
+    builds with ``mesh=None``) and bare constructions honor the same host
+    sizing as factory builds, and a rank restart cannot silently spread
+    onto chips the operator excluded. An explicit integer (factory
+    ``mesh_devices`` pins) bypasses the env; 0 means ALL visible devices
+    in both channels."""
+    if n_devices is None:
+        from distributed_faiss_tpu.utils.config import MeshCfg
+
+        n_devices = MeshCfg.from_env().devices
     devs = jax.devices()
-    if n_devices is not None:
+    if n_devices:  # 0 = every visible device
         if n_devices > len(devs):
             raise ValueError(f"requested {n_devices} devices, have {len(devs)}")
         devs = devs[:n_devices]
@@ -130,18 +159,37 @@ def _sharded_knn_jit(q, x, ntotals, mesh, k: int, metric: str, chunk: int):
     return fn(q, x, ntotals)
 
 
+def _knn_chunk(cap_local: int, chunk: int = 65536) -> int:
+    """Largest power-of-two scan chunk that divides the per-shard capacity
+    (we can't pad a sharded array the way distance.knn pads a local one)."""
+    c = 1
+    while c * 2 <= min(chunk, cap_local) and cap_local % (c * 2) == 0:
+        c *= 2
+    return c
+
+
 def sharded_knn(mesh: Mesh, q, x, ntotals, k: int, metric: str = "l2",
                 chunk: int = 65536):
     """Exact k-nn over a row-sharded corpus with distributed top-k merge.
 
     chunk is clamped to the largest power-of-two divisor of the per-shard
-    capacity (we can't pad a sharded array here the way distance.knn pads a
-    local one)."""
+    capacity (see _knn_chunk)."""
     cap_local = x.shape[0] // mesh.shape[AXIS]
-    c = 1
-    while c * 2 <= min(chunk, cap_local) and cap_local % (c * 2) == 0:
-        c *= 2
-    return _sharded_knn_jit(q, x, ntotals, mesh, k, metric, c)
+    return _sharded_knn_jit(q, x, ntotals, mesh, k, metric,
+                            _knn_chunk(cap_local, chunk))
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "metric", "chunk"))
+def _sharded_knn_fused(q3, x, ntotals, mesh, k: int, metric: str, chunk: int):
+    """Multi-block sharded exact search in ONE launch: lax.map over stacked
+    (nblocks, block, d) query blocks, shard_map per block inside — the flat
+    analog of _sharded_ivf_flat_search_fused, so a merged serving window
+    never pays one dispatch (or one host round-trip) per block."""
+
+    def body(qb):
+        return _sharded_knn_jit(qb, x, ntotals, mesh, k, metric, chunk)
+
+    return jax.lax.map(body, q3)
 
 
 # --------------------------------------------------------------------- kmeans
@@ -223,6 +271,22 @@ def sharded_kmeans(mesh: Mesh, x: np.ndarray, k: int, iters: int = 10,
     return cent
 
 
+def _counted(index, call):
+    """Wrap a device-program launch callable so ``index.launches`` counts
+    every dispatch the block/fused/routed driver issues (routed drop-retry
+    relaunches included — they are real dispatches; the PQ paths count
+    inside the pallas degrade ladder instead, so a proven-failure XLA
+    re-dispatch is counted too). The counter is what lets
+    engine._device_search report launches-per-merged-window — ==1.0 is
+    the masked-mode serving contract (ISSUE 6)."""
+
+    def wrapped(*args, **kwargs):
+        index.launches += 1
+        return call(*args, **kwargs)
+
+    return wrapped
+
+
 # --------------------------------------------------------------- index models
 
 
@@ -251,6 +315,11 @@ class ShardedFlatIndex(base.TpuIndex):
         # (VERDICT r4: no permanent host corpus mirror)
         self._pending: list = []
         self._n = 0
+        # device-program dispatch counter (monotonic): one increment per
+        # pjit launch issued by the search driver. engine._device_search
+        # diffs it around each merged window to report launches-per-window
+        # (docs/OPERATIONS.md#multi-chip-serving)
+        self.launches = 0
         self._dev = None       # (S * cap_local, d) sharded
         self._ntotals = None   # (S,) int32
         self._cap_local = 0
@@ -329,23 +398,29 @@ class ShardedFlatIndex(base.TpuIndex):
         self._update_counts()
 
     def search(self, q: np.ndarray, k: int):
+        """One pjit launch per call, however many query blocks the batch
+        spans: the shared ``base.blocked_search`` driver sends a single
+        block straight to the shard_map program and rides a multi-block
+        batch through the fused lax.map entry (the per-block Python loop
+        with its per-block np.asarray round-trip is gone — results leave
+        the device exactly once per merged window). Contiguous block
+        layout: shard*cap_local + pos IS the insertion-order global id, so
+        no remap is needed."""
         if self._n == 0:
             d = np.full((q.shape[0], k), np.inf if self.metric == "l2" else -np.inf, np.float32)
             return d, np.full((q.shape[0], k), -1, np.int64)
         self._sync()
-        nq = q.shape[0]
-        out_s = np.empty((nq, k), np.float32)
-        out_i = np.empty((nq, k), np.int64)
-        for s, n, blockq in base.query_blocks(np.asarray(q, np.float32),
-                                              base.pick_query_block(65536 * 4)):
-            vals, ids = sharded_knn(
-                self.mesh, jnp.asarray(blockq), self._dev, self._ntotals, k, self.metric
-            )
-            out_s[s:s + n] = np.asarray(vals)[:n]
-            out_i[s:s + n] = np.asarray(ids)[:n]
-        # contiguous block layout: shard*cap_local + pos IS the insertion-
-        # order global id, so no remap is needed
-        return base.finalize_results(out_s, out_i, self.metric)
+        chunk = _knn_chunk(self._cap_local)
+        return base.blocked_search(
+            q, k, self.metric,
+            _counted(self, lambda b: _sharded_knn_jit(
+                b, self._dev, self._ntotals, self.mesh, k, self.metric,
+                chunk)),
+            block=base.pick_query_block(65536 * 4),
+            fused_fn=_counted(self, lambda q3: _sharded_knn_fused(
+                q3, self._dev, self._ntotals, self.mesh, k, self.metric,
+                chunk)),
+        )
 
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
         ids = np.asarray(ids, np.int64)
@@ -644,6 +719,7 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
         # scan FLOPs scale with the mesh (vs ownership masking, which only
         # scales capacity); see _sharded_ivf_flat_search_routed
         self.probe_routing = probe_routing
+        self.launches = 0  # device-dispatch counter (see _counted)
 
     def _train_centroids(self, x: np.ndarray):
         self.centroids = sharded_kmeans(self.mesh, x, self.nlist, iters=self.kmeans_iters)
@@ -667,25 +743,25 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
             group = max(8, min(1024, (64 << 20) // max(1, self.lists.cap * self.dim * 4)))
             return _routed_search_blocks(
                 self, q, k, nprobe, group,
-                lambda block, n, bucket: _sharded_ivf_flat_search_routed(
+                _counted(self, lambda block, n, bucket: _sharded_ivf_flat_search_routed(
                     self.centroids, self.lists.data, self.lists.ids,
                     self.lists.sizes, block, n, self.mesh, k, nprobe, bucket,
                     group, self.metric, list_norms=norms,
-                ),
+                )),
             )
         nb = base.pick_query_block(self.lists.cap * self.dim * 4)
         gsz = probe_group_size(nprobe, nb * self.lists.cap * self.dim * 4)
         return self._search_blocks(
             q, k,
-            lambda b: _sharded_ivf_flat_search(
+            _counted(self, lambda b: _sharded_ivf_flat_search(
                 self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
                 b, self.mesh, k, nprobe, gsz, self.metric, list_norms=norms,
-            ),
+            )),
             block=nb,
-            fused_fn=lambda q3: _sharded_ivf_flat_search_fused(
+            fused_fn=_counted(self, lambda q3: _sharded_ivf_flat_search_fused(
                 self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
                 q3, self.mesh, k, nprobe, gsz, self.metric, list_norms=norms,
-            ),
+            )),
         )
 
     def state_dict(self):
@@ -896,6 +972,7 @@ class ShardedIVFPQIndex(IVFPQIndex):
         self.raw_lists: Optional[ShardedPaddedLists] = None
         self.mesh = mesh or make_mesh()
         self.probe_routing = probe_routing
+        self.launches = 0  # device-dispatch counter (see _counted)
 
     def _train_centroids(self, x: np.ndarray):
         self.centroids = sharded_kmeans(self.mesh, x, self.nlist, iters=self.kmeans_iters)
@@ -959,9 +1036,12 @@ class ShardedIVFPQIndex(IVFPQIndex):
             # same degrade ladder as the unsharded path: nibble pallas ->
             # one-hot pallas -> XLA, one rung per proven failure; the first
             # arg is always the query block/stack, whose shape keys the
-            # both-failed signature (ADVICE r5)
+            # both-failed signature (ADVICE r5). launches counts INSIDE the
+            # ladder so a proven-failure XLA re-dispatch is a second counted
+            # launch (the perf rows must expose the degrade, not hide it)
             return ivfmod.pallas_guarded(
-                self, lambda p: call(*args, p), self.m, self.codebooks.shape[1],
+                self, _counted(self, lambda p: call(*args, p)),
+                self.m, self.codebooks.shape[1],
                 shape=tuple(args[0].shape),
             )
 
@@ -982,9 +1062,10 @@ class ShardedIVFPQIndex(IVFPQIndex):
                 lut_bf16=pallas_on and self.adc_lut_bf16,
             )
 
-        return self._search_blocks(q, k, lambda b: guarded(run_masked, b),
-                                   block=nb,
-                                   fused_fn=lambda q3: guarded(run_masked_fused, q3))
+        return self._search_blocks(
+            q, k, lambda b: guarded(run_masked, b),
+            block=nb,
+            fused_fn=lambda q3: guarded(run_masked_fused, q3))
 
     def state_dict(self):
         state = super().state_dict()

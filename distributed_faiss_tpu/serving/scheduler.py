@@ -29,6 +29,16 @@ alone. The splitter routes rows purely positionally from the extraction
 order — a caller can get *no* result or an error, never another
 caller's rows.
 
+One flush = one engine call = (on a mesh-backed index) ONE pjit launch:
+``search_fn`` is ``engine.Index.search_batched``, whose locked device
+step routes through ``TpuIndex.search_batched`` — for a rank that owns a
+device mesh the whole merged window crosses to the chips as a single
+device program with the top-k reduce on-mesh, and results leave the
+device once per window (parallel/mesh.py; the engine's
+``device_launches`` perf rows pin the contract). The group key already
+isolates ``(index_id, top_k, return_embeddings, dim)``, so every row of
+a flushed batch is legal in the same launch by construction.
+
 Observability rides the shared ``LatencyStats`` histogram surface
 (utils/tracing.py): queue-wait and end-to-end latency with streaming
 percentiles, batch occupancy (requests and rows per launch), queue depth

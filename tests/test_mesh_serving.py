@@ -1,0 +1,350 @@
+"""Mesh-sharded serving path: one pjit launch per merged batch (ISSUE 6).
+
+Covers the serving contract on the virtual 8-device CPU mesh
+(tests/conftest.py): every sharded index's search issues exactly ONE
+device dispatch per call (single-block direct, multi-block fused), the
+engine routes merged windows through ``TpuIndex.search_batched`` and
+reports ``device_launches`` / ``rows_per_launch``, the env-backed mesh
+knobs (DFT_MESH_DEVICES / DFT_MESH_MODE) resolve through the factory,
+and a mesh-backed rank round-trips the generation/MANIFEST persistence
+machinery including checksum-verified fallback.
+
+Marked ``mesh`` (own CI job with the forced 8-device platform); these
+tests are fast and also run in tier-1.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.parallel.mesh import (
+    ShardedFlatIndex,
+    ShardedIVFFlatIndex,
+    ShardedIVFPQIndex,
+    make_mesh,
+    sharded_knn,
+)
+
+pytestmark = pytest.mark.mesh
+
+
+def brute_ids(q, x, k, metric="l2"):
+    if metric == "dot":
+        s = q @ x.T
+    else:
+        s = -((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    return np.argsort(-s, axis=1)[:, :k]
+
+
+# ------------------------------------------------------- one-launch contract
+
+
+def test_sharded_flat_single_block_one_launch(rng):
+    x = rng.standard_normal((900, 16)).astype(np.float32)
+    q = rng.standard_normal((24, 16)).astype(np.float32)
+    idx = ShardedFlatIndex(16, "l2")
+    idx.add(x)
+    assert idx.launches == 0
+    D, I = idx.search(q, 5)
+    assert idx.launches == 1
+    np.testing.assert_array_equal(I, brute_ids(q, x, 5))
+    idx.search(q[:3], 5)
+    assert idx.launches == 2  # one per call, not per block/row
+
+
+def test_sharded_flat_multi_block_fused_one_launch(rng):
+    """A batch spanning several query blocks must ride the fused lax.map
+    entry: ONE dispatch, results byte-identical to the per-block launches
+    it replaced (the old query_blocks loop round-tripped per block)."""
+    import jax.numpy as jnp
+
+    from distributed_faiss_tpu.models import base
+    from distributed_faiss_tpu.ops import distance
+
+    x = rng.standard_normal((3000, 16)).astype(np.float32)
+    q = rng.standard_normal((2100, 16)).astype(np.float32)
+    idx = ShardedFlatIndex(16, "l2")
+    idx.add(x)
+    D, I = idx.search(q, 5)
+    assert idx.launches == 1
+
+    # reference: the pre-fused shape — one sharded_knn launch per block
+    idx._sync()
+    block = base.pick_query_block(65536 * 4)
+    ref_s = np.empty((q.shape[0], 5), np.float32)
+    ref_i = np.empty((q.shape[0], 5), np.int64)
+    for s, n, chunk in base.query_blocks(q, block):
+        vals, ids = sharded_knn(idx.mesh, jnp.asarray(chunk), idx._dev,
+                                idx._ntotals, 5, "l2")
+        ref_s[s:s + n] = np.asarray(vals)[:n]
+        ref_i[s:s + n] = np.asarray(ids)[:n]
+    ref_s, ref_i = base.finalize_results(ref_s, ref_i, "l2")
+    np.testing.assert_array_equal(D, ref_s)
+    np.testing.assert_array_equal(I, ref_i)
+
+
+@pytest.mark.parametrize("routing", [False, True])
+def test_sharded_ivf_flat_one_launch_exact(rng, routing):
+    x = rng.standard_normal((1200, 16)).astype(np.float32)
+    q = rng.standard_normal((20, 16)).astype(np.float32)
+    idx = ShardedIVFFlatIndex(16, 8, "l2", probe_routing=routing)
+    idx.train(x[:600])
+    idx.add(x)
+    idx.set_nprobe(8)  # full probe: exact by construction
+    assert idx.launches == 0
+    D, I = idx.search(q, 5)
+    # masked: one dispatch; routed: one per (block, bucket-retry) — with
+    # uniform full probe there are no drops, so still exactly one
+    assert idx.launches == 1
+    np.testing.assert_array_equal(I, brute_ids(q, x, 5))
+
+
+def test_sharded_ivf_pq_counts_launches(rng):
+    x = rng.standard_normal((800, 16)).astype(np.float32)
+    q = rng.standard_normal((12, 16)).astype(np.float32)
+    idx = ShardedIVFPQIndex(16, 4, m=4, metric="l2")
+    idx.train(x[:400])
+    idx.add(x)
+    idx.set_nprobe(4)
+    idx.search(q, 5)
+    assert idx.launches == 1
+    idx.search(q, 5)
+    assert idx.launches == 2
+
+
+def test_search_batched_default_matches_search(rng):
+    """TpuIndex.search_batched (the scheduler's launch target) is plain
+    search for every model; mesh models guarantee one launch behind it."""
+    x = rng.standard_normal((500, 8)).astype(np.float32)
+    q = rng.standard_normal((9, 8)).astype(np.float32)
+    idx = ShardedFlatIndex(8, "dot")
+    idx.add(x)
+    a = idx.search(q, 4)
+    b = idx.search_batched(q, 4)
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+    assert idx.launches == 2
+
+
+# ------------------------------------------------- engine routing + counters
+
+
+def _trained_mesh_engine(tmp_path, rng, n=600, d=16, extra=None):
+    from distributed_faiss_tpu.engine import Index
+    from distributed_faiss_tpu.utils.config import IndexCfg
+    from distributed_faiss_tpu.utils.state import IndexState
+
+    kw = dict(index_builder_type="flat", dim=d, metric="l2", train_num=64,
+              index_storage_dir=str(tmp_path), mesh_shards=True)
+    kw.update(extra or {})
+    idx = Index(IndexCfg(**kw))
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    idx.add_batch(x, [("m", i) for i in range(n)],
+                  train_async_if_triggered=False)
+    idx.train()
+    deadline = time.time() + 120
+    while (idx.get_state() != IndexState.TRAINED
+           or idx.get_idx_data_num()[0] > 0):
+        assert time.time() < deadline, "train/add drain timed out"
+        time.sleep(0.02)
+    return idx, x
+
+
+def test_engine_merged_window_is_one_launch(tmp_path, rng):
+    """engine.search_batched (what the scheduler calls per flush) must cost
+    exactly one device dispatch on a mesh index, and the new perf rows
+    must say so."""
+    idx, x = _trained_mesh_engine(tmp_path, rng)
+    assert isinstance(idx.tpu_index, ShardedFlatIndex)
+    q = rng.standard_normal((32, 16)).astype(np.float32)  # 8x4-row window
+    for _ in range(3):
+        idx.search_batched(q, 3)
+    s = idx.perf.summary()
+    assert s["device_launches"]["count"] == 3
+    assert s["device_launches"]["max_s"] == 1.0  # ONE launch per window
+    assert s["rows_per_launch"]["max_s"] == 32.0
+    assert s["device_search_rows"]["max_s"] == 32.0
+
+
+def test_engine_search_and_batched_identical_on_mesh(tmp_path, rng):
+    idx, x = _trained_mesh_engine(tmp_path, rng)
+    q = rng.standard_normal((8, 16)).astype(np.float32)
+    s1, m1, _ = idx.search(q, 3)
+    s2, m2, _ = idx.search_batched(q, 3)
+    np.testing.assert_array_equal(s1, s2)
+    assert m1 == m2
+
+
+# ------------------------------------------------------------ env mesh knobs
+
+
+def test_mesh_cfg_env_parsing():
+    from distributed_faiss_tpu.utils.config import MeshCfg
+
+    cfg = MeshCfg.from_env({})
+    assert cfg.devices == 0 and cfg.mode == "masked"
+    cfg = MeshCfg.from_env({"DFT_MESH_DEVICES": "4", "DFT_MESH_MODE": "routed"})
+    assert cfg.devices == 4 and cfg.mode == "routed"
+    with pytest.raises(ValueError, match="mode"):
+        MeshCfg.from_env({"DFT_MESH_MODE": "zigzag"})
+    with pytest.raises(ValueError, match="devices"):
+        MeshCfg(devices=-1)
+    with pytest.raises(TypeError, match="unknown"):
+        MeshCfg(chips=8)
+
+
+def test_factory_resolves_env_mesh_knobs(monkeypatch):
+    from distributed_faiss_tpu.models.factory import build_index
+    from distributed_faiss_tpu.utils.config import IndexCfg
+
+    monkeypatch.setenv("DFT_MESH_DEVICES", "4")
+    monkeypatch.setenv("DFT_MESH_MODE", "routed")
+    cfg = IndexCfg(index_builder_type="ivf_tpu", dim=8, metric="l2",
+                   centroids=4, shard_lists=True)
+    idx = build_index(cfg)
+    assert isinstance(idx, ShardedIVFFlatIndex)
+    assert idx.mesh.devices.size == 4  # DFT_MESH_DEVICES
+    assert idx.probe_routing is True   # DFT_MESH_MODE=routed
+
+    # explicit cfg.extra pins win over the host env defaults
+    cfg2 = IndexCfg(index_builder_type="ivf_tpu", dim=8, metric="l2",
+                    centroids=4, shard_lists=True, mesh_devices=2,
+                    probe_routing=False)
+    idx2 = build_index(cfg2)
+    assert idx2.mesh.devices.size == 2
+    assert idx2.probe_routing is False
+
+
+def test_restore_honors_host_mesh_devices(monkeypatch, rng):
+    """from_state_dict builds with mesh=None -> make_mesh(None), which must
+    apply the per-host DFT_MESH_DEVICES default: a restored rank may not
+    silently spread onto chips the operator excluded."""
+    from distributed_faiss_tpu.models.factory import index_from_state_dict
+
+    x = rng.standard_normal((300, 8)).astype(np.float32)
+    q = rng.standard_normal((5, 8)).astype(np.float32)
+    idx = ShardedFlatIndex(8, "l2")  # built on all 8 virtual devices
+    idx.add(x)
+    golden = idx.search(q, 3)
+    state = idx.state_dict()
+
+    monkeypatch.setenv("DFT_MESH_DEVICES", "2")
+    restored = index_from_state_dict(state)
+    assert restored.nshards == 2  # host env applied on restore
+    got = restored.search(q, 3)
+    np.testing.assert_array_equal(golden[0], got[0])
+    np.testing.assert_array_equal(golden[1], got[1])
+
+
+def test_factory_env_devices_apply_to_flat_mesh(monkeypatch):
+    from distributed_faiss_tpu.models.factory import build_index
+    from distributed_faiss_tpu.utils.config import IndexCfg
+
+    monkeypatch.setenv("DFT_MESH_DEVICES", "2")
+    idx = build_index(IndexCfg(index_builder_type="flat", dim=8, metric="l2",
+                               mesh_shards=True))
+    assert isinstance(idx, ShardedFlatIndex)
+    assert idx.nshards == 2
+
+
+# ------------------------------------------------------- persistence (engine)
+
+
+def test_mesh_engine_generation_round_trip(tmp_path, rng):
+    """A mesh-backed rank rides the generation/MANIFEST machinery: save,
+    restore, byte-identical serving."""
+    from distributed_faiss_tpu.engine import Index
+    from distributed_faiss_tpu.utils import serialization
+
+    idx, x = _trained_mesh_engine(tmp_path / "shard", rng)
+    q = rng.standard_normal((16, 16)).astype(np.float32)
+    golden = idx.search_batched(q, 3)
+    assert idx.save()
+    assert serialization.list_generations(str(tmp_path / "shard"))
+
+    loaded = Index.from_storage_dir(str(tmp_path / "shard"))
+    assert isinstance(loaded.tpu_index, ShardedFlatIndex)
+    got = loaded.search_batched(q, 3)
+    np.testing.assert_array_equal(golden[0], got[0])
+    assert golden[1] == got[1]
+
+
+def test_mesh_engine_torn_generation_falls_back(tmp_path, rng):
+    """Corrupting the newest committed generation of a mesh-backed shard
+    must quarantine it and serve the previous one (the checksum-verified
+    fallback the chaos gate relies on)."""
+    from distributed_faiss_tpu.engine import Index
+    from distributed_faiss_tpu.utils import serialization
+
+    storage = tmp_path / "shard"
+    idx, x = _trained_mesh_engine(storage, rng)
+    q = rng.standard_normal((16, 16)).astype(np.float32)
+    golden = idx.search_batched(q, 3)
+    assert idx.save()
+
+    # a second, newer generation...
+    extra = rng.standard_normal((40, 16)).astype(np.float32)
+    idx.add_batch(extra, [("m", 600 + i) for i in range(40)],
+                  train_async_if_triggered=False)
+    deadline = time.time() + 60
+    while idx.get_idx_data_num()[0] > 0:
+        assert time.time() < deadline
+        time.sleep(0.02)
+    assert idx.save()
+    gen, mpath = serialization.list_generations(str(storage))[0]
+    with open(mpath) as f:
+        manifest = json.load(f)
+    victim = os.path.join(str(storage), manifest["files"]["index"]["name"])
+    with open(victim, "r+b") as f:  # ...torn at a random-ish byte offset
+        f.seek(12)
+        f.write(b"\xde\xad\xbe\xef")
+
+    loaded = Index.from_storage_dir(str(storage))
+    assert loaded is not None
+    assert loaded.tpu_index.ntotal == 600  # previous generation
+    got = loaded.search_batched(q, 3)
+    np.testing.assert_array_equal(golden[0], got[0])
+    assert golden[1] == got[1]
+    qdir = os.path.join(str(storage), "quarantine")
+    assert os.path.isdir(qdir) and os.listdir(qdir)
+
+
+def test_sharded_ivf_engine_round_trip(tmp_path, rng):
+    """ivf_tpu + shard_lists (sharded inverted lists) through the same
+    engine save/restore path: the gather-to-host state dict must stream
+    the mesh-resident lists back bit-identically."""
+    from distributed_faiss_tpu.engine import Index
+    from distributed_faiss_tpu.utils.config import IndexCfg
+    from distributed_faiss_tpu.utils.state import IndexState
+
+    cfg = IndexCfg(index_builder_type="ivf_tpu", dim=16, metric="l2",
+                   train_num=64, centroids=8, nprobe=8,
+                   index_storage_dir=str(tmp_path), shard_lists=True)
+    idx = Index(cfg)
+    x = rng.standard_normal((500, 16)).astype(np.float32)
+    idx.add_batch(x, [("m", i) for i in range(500)],
+                  train_async_if_triggered=False)
+    idx.train()
+    deadline = time.time() + 300
+    while (idx.get_state() != IndexState.TRAINED
+           or idx.get_idx_data_num()[0] > 0):
+        assert time.time() < deadline, "train/add drain timed out"
+        time.sleep(0.05)
+    assert isinstance(idx.tpu_index, ShardedIVFFlatIndex)
+    q = rng.standard_normal((10, 16)).astype(np.float32)
+    golden = idx.search_batched(q, 3)
+    assert idx.save()
+
+    from distributed_faiss_tpu.engine import Index as Engine
+
+    loaded = Engine.from_storage_dir(str(tmp_path))
+    assert isinstance(loaded.tpu_index, ShardedIVFFlatIndex)
+    got = loaded.search_batched(q, 3)
+    np.testing.assert_array_equal(golden[0], got[0])
+    assert golden[1] == got[1]
+    # the restored rank still serves one launch per merged window
+    s = loaded.perf.summary()
+    assert s["device_launches"]["max_s"] == 1.0

@@ -35,9 +35,19 @@ SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*ok\(([^)]*)\)")
 HOT_RE = re.compile(r"#\s*graftlint:\s*hot\b")
 
 # call-graph roots for the hot-path walk (module path suffix, qualname);
-# any function annotated `# graftlint: hot` is an additional root
+# any function annotated `# graftlint: hot` is an additional root.
+# Index.search_batched is the scheduler's launch target (the merged-window
+# serving path reaches the engine through it, not through Index.search),
+# and the mesh search entry points are the one-launch serving programs —
+# rooting them keeps the host-sync checker policing the multi-chip path
+# even where dynamic dispatch (scheduler callbacks, tpu_index attribute
+# calls) hides the edges from the name-based walk.
 HOT_ROOTS: Tuple[Tuple[str, str], ...] = (
     ("engine.py", "Index.search"),
+    ("engine.py", "Index.search_batched"),
+    ("parallel/mesh.py", "ShardedFlatIndex.search"),
+    ("parallel/mesh.py", "ShardedIVFFlatIndex.search"),
+    ("parallel/mesh.py", "ShardedIVFPQIndex.search"),
 )
 
 # module aliases that resolve to code outside this repo: attribute calls
