@@ -31,7 +31,7 @@ from typing import List, Optional, Tuple, Union
 import numpy as np
 
 from distributed_faiss_tpu.models.factory import build_index, index_from_state_dict
-from distributed_faiss_tpu.utils import serialization
+from distributed_faiss_tpu.utils import lockdep, serialization
 from distributed_faiss_tpu.utils.batching import SearchBatcher
 from distributed_faiss_tpu.utils.config import IndexCfg
 from distributed_faiss_tpu.utils.serialization import (
@@ -146,8 +146,12 @@ class Index:
         self.embeddings_buffer: List[np.ndarray] = []
         self.total_data = 0
         self.id_to_metadata = _MetaStore()
-        self.buffer_lock = threading.Lock()
-        self.index_lock = threading.Lock()
+        # pinned locks ride the lockdep factories: plain threading.Lock
+        # by default, the DFT_LOCKDEP=1 runtime lock-order witness in the
+        # lockdep test tier (utils/lockdep.py; keys match the graftlint
+        # PINS map spelling)
+        self.buffer_lock = lockdep.lock("Index.buffer_lock")
+        self.index_lock = lockdep.lock("Index.index_lock")
         self.state = IndexState.NOT_TRAINED
         self.tpu_index = None  # models.base.TpuIndex once trained
 
@@ -363,6 +367,7 @@ class Index:
 
     # ------------------------------------------------------------------ query
 
+    # graftlint: ok(blocking-under-lock): the designed locked launch — one in-flight device search per index IS the serialization contract
     def _device_search(self, query_batch: np.ndarray, top_k: int):
         """The locked device launch behind the batcher: one in-flight
         search per index (reference rationale at index.py:246-252; the
@@ -431,6 +436,7 @@ class Index:
                 query_batch, top_k)
         return self._join_results(scores, indexes, embs_arr, return_embeddings)
 
+    # graftlint: ok(blocking-under-lock): deliberate locked launches — ids and reconstructed embeddings must come from one atomic index state
     def _search_reconstruct(self, query_batch: np.ndarray, top_k: int):
         """Search + embedding reconstruction. Embeddings must come from the
         SAME index state that produced the ids, so this path stays atomic
@@ -573,6 +579,7 @@ class Index:
             gen = max(self._generation, disk_gens[0][0] if disk_gens else 0) + 1
             plan = {
                 "index": ("npz", "wb",
+                          # graftlint: ok(blocking-under-lock): designed locked fetch — the snapshot must capture index+buffer+meta at one atomic point
                           lambda f: save_state(f, self.tpu_index.state_dict())),
                 "meta": ("pkl", "wb",
                          lambda f: pickle.dump(self.id_to_metadata.tolist(), f)),

@@ -43,6 +43,7 @@ import time
 
 import numpy as np
 
+from distributed_faiss_tpu.utils import lockdep
 from distributed_faiss_tpu.utils.tracing import LatencyStats
 
 DEFAULT_PORT = 12032  # same default port as the reference (rpc.py:22)
@@ -456,7 +457,7 @@ class Client:
         self.port = port
         self._fam = socket.AF_INET6 if v6 else socket.AF_INET
         self._mux = mux_enabled_by_env() if mux is None else bool(mux)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("Client._lock")
         self._closed = False
         self._shutdown = False
         self._next_redial = 0.0
@@ -631,6 +632,7 @@ class Client:
             return self._call_serial(fname, args, kwargs, timeout, deadline)
         # ---- ensure a live connection (lock held briefly; may redial) ----
         with self._lock:
+            # graftlint: ok(blocking-under-lock): redial backoff is bounded by RECONNECT_TIMEOUT and must serialize under the stub lock (connection state)
             self._ensure_connected_locked()
             epoch = self._epoch
             sock = self.sock
@@ -670,6 +672,7 @@ class Client:
             if len(self._pending) > self._inflight_peak:
                 self._inflight_peak = len(self._pending)
             try:
+                # graftlint: ok(blocking-under-lock): the atomic frame write is the one op the mux lock exists for; SO_SNDTIMEO (bound_send_timeout) bounds a zero-progress send
                 _send_parts(self.sock, parts)
             except BaseException as e:
                 # a torn mid-frame write desyncs the stream for EVERY
@@ -703,7 +706,14 @@ class Client:
             if owned:
                 slot.event.set()
             else:
-                slot.event.wait()  # a response raced the timeout: take it
+                # a response raced the timeout: the reader has already
+                # popped the slot and sets the event microseconds after
+                # filling it. A reader that dies BETWEEN pop and set
+                # orphans the slot (the teardown path only fails slots
+                # still in _pending), so bound the wait and surface the
+                # original timeout instead of hanging forever.
+                if not slot.event.wait(timeout=3.0):
+                    raise exc
         if slot.error is not None:
             raise slot.error
         # record completed round trips only (parity with the serial path:
@@ -711,6 +721,7 @@ class Client:
         self.stats.record("round_trip_s", time.perf_counter() - t0)
         return self._interpret(slot.kind, slot.payload, fname)
 
+    # graftlint: ok(blocking-under-lock): the serial client holds the stub lock across the round trip BY DEFINITION (one call per connection); per-call `timeout` bounds the socket when the caller asks
     def _call_serial(self, fname, args, kwargs, timeout, deadline):
         """The pre-mux client: ``_lock`` held across the whole round trip,
         frames only carry meta when a deadline is set (byte-compatible with
@@ -807,6 +818,7 @@ class Client:
             if not self._closed:
                 self._closed = True
                 try:
+                    # graftlint: ok(blocking-under-lock): teardown courtesy frame, bounded by SO_SNDTIMEO; the lock must be held so no call can interleave with the CLOSE
                     send_frame(self.sock, KIND_CLOSE, None)
                 except OSError:
                     pass

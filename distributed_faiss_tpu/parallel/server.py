@@ -40,6 +40,7 @@ from distributed_faiss_tpu.serving.scheduler import (
     SchedulerStopped,
     SearchScheduler,
 )
+from distributed_faiss_tpu.utils import lockdep
 from distributed_faiss_tpu.utils.config import IndexCfg, SchedulerCfg
 from distributed_faiss_tpu.utils.state import IndexState
 from distributed_faiss_tpu.utils.tracing import LatencyStats
@@ -73,7 +74,7 @@ class IndexServer:
     def __init__(self, rank: int, index_storage_dir: str,
                  scheduler_cfg: Optional[SchedulerCfg] = None):
         self.indexes: Dict[str, Index] = {}
-        self.indexes_lock = threading.Lock()
+        self.indexes_lock = lockdep.lock("IndexServer.indexes_lock")
         self.rank = rank
         self.index_storage_dir = index_storage_dir
         self.socket: Optional[socket.socket] = None
@@ -81,7 +82,7 @@ class IndexServer:
         self.perf = LatencyStats()  # per-RPC latency counters (SURVEY §5.1)
         # background work (async training) runs on named, tracked threads so
         # stop() can wait for them instead of orphaning device work
-        self._threads_lock = threading.Lock()
+        self._threads_lock = lockdep.lock("IndexServer._threads_lock")
         self._train_threads: List[threading.Thread] = []
         # serving scheduler: both serving loops hand `search` RPCs to its
         # bounded queue + batcher thread (serving/scheduler.py); every other
@@ -104,7 +105,7 @@ class IndexServer:
         self._rpc_workers = ThreadPoolExecutor(
             max_workers=self._rpc_worker_count,
             thread_name_prefix=f"rpc-worker:r{rank}")
-        self._mux_lock = threading.Lock()
+        self._mux_lock = lockdep.lock("IndexServer._mux_lock")
         self._mux_inflight = 0
         self._mux_counters = {"mux_calls": 0, "legacy_calls": 0}
 
@@ -360,7 +361,7 @@ class IndexServer:
         # whichever thread completes the call (scheduler batcher via the
         # worker pool, or a worker running a direct op), so frame writes
         # must be serialized against each other and the sync path
-        wlock = threading.Lock()
+        wlock = lockdep.lock("IndexServer.conn_wlock")
         try:
             while True:
                 self._one_call(conn, wlock=wlock)
@@ -636,7 +637,7 @@ class IndexServer:
                     # against each other and the inline legacy path
                     rpc.bound_send_timeout(conn)
                     sel.register(conn, selectors.EVENT_READ,
-                                 data=(addr, threading.Lock()))
+                                 data=(addr, lockdep.lock("IndexServer.conn_wlock")))
                 else:
                     conn = key.fileobj
                     addr, wlock = key.data

@@ -53,6 +53,7 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from distributed_faiss_tpu.utils import lockdep
 from distributed_faiss_tpu.utils.config import SchedulerCfg
 from distributed_faiss_tpu.utils.tracing import LatencyStats
 
@@ -140,7 +141,7 @@ class SearchScheduler:
                  name: str = "search-batcher"):
         self._search_fn = search_fn
         self.cfg = cfg if cfg is not None else SchedulerCfg()
-        self._cond = threading.Condition()
+        self._cond = lockdep.condition("SearchScheduler._cond")
         self._queue: List[_Request] = []
         self._stopping = False
         self.stats = LatencyStats()
@@ -171,7 +172,17 @@ class SearchScheduler:
         req = self.submit_async(index_id, query_batch, top_k,
                                 return_embeddings, deadline=deadline,
                                 eager=eager)
-        req.event.wait()
+        # timeout-with-retry rather than one untimed wait: every admitted
+        # request is eventually finished by the batcher (its loop survives
+        # flush failures and stop() drains the queue) — the escape hatch
+        # covers the one way that contract can break, the batcher thread
+        # itself dying (interpreter teardown, untrappable error), which
+        # would otherwise strand this caller forever
+        while not req.event.wait(timeout=5.0):
+            if not self._thread.is_alive() and not req.event.is_set():
+                raise SchedulerStopped(
+                    "scheduler batcher thread died with this request "
+                    "in flight")
         if req.error is not None:
             raise req.error
         self.stats.record("e2e_s", time.monotonic() - req.enqueue_t)
@@ -270,7 +281,12 @@ class SearchScheduler:
                 if self._stopping:
                     return None
                 if not self._queue:
-                    self._cond.wait()
+                    # timed idle wait (blocking-under-lock): submit()
+                    # notifies on every enqueue, so the timeout only
+                    # bounds the window in which a lost/raced notify (or
+                    # an interpreter bug) could strand the batcher — the
+                    # loop re-checks the queue and stop flag each lap
+                    self._cond.wait(timeout=1.0)
                     continue
                 head = self._queue[0]
                 rows = sum(r.rows for r in self._queue if r.key == head.key)

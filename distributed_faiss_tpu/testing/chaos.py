@@ -27,6 +27,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from distributed_faiss_tpu.parallel import launcher
+from distributed_faiss_tpu.utils import lockdep
 
 logger = logging.getLogger()
 
@@ -112,7 +113,7 @@ class ChaosProxy:
                  listen_port: int = 0, plan: Optional[List[Optional[Fault]]] = None):
         self.target = (target_host, target_port)
         self._listen_port = listen_port
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ChaosProxy._lock")
         self._plan: List[Optional[Fault]] = list(plan) if plan else []
         self._default_fault: Optional[Fault] = None
         self._accepted = 0
@@ -285,7 +286,7 @@ class ServerHarness:
         self.storage_dir = storage_dir
         self.base_port = base_port
         self.env = dict(env) if env else {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ServerHarness._lock")
         self.procs: Dict[int, subprocess.Popen] = {}
 
     def port(self, rank: int) -> int:

@@ -6,11 +6,15 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "graftlint: linting distributed_faiss_tpu/ + tools/"
+echo "graftlint: linting distributed_faiss_tpu/ + tools/ (all 9 checkers)"
 python -m tools.graftlint distributed_faiss_tpu tools
 
 echo "graftlint: lint test tier"
 JAX_PLATFORMS=cpu python -m pytest tests/test_graftlint.py -q -m lint \
     -p no:cacheprovider
+
+echo "lockdep: runtime lock-order witness unit tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_lockdep.py -q \
+    -m "lockdep and not slow" -p no:cacheprovider
 
 echo "precommit: OK"

@@ -1,0 +1,49 @@
+"""blocking-under-lock known-bad fixture: direct socket/join/wait/sleep
+blocking under a held lock, an indirect (helper-hidden) socket write,
+and a jitted device launch under a lock."""
+
+import threading
+import time
+
+import jax
+
+
+@jax.jit
+def _scan(x):
+    return x
+
+
+def _push(sock, data):
+    sock.sendall(data)
+
+
+class Conn:
+    def __init__(self, sock, thread):
+        self.sock = sock
+        self.thread = thread
+        self.lock = threading.Lock()
+        self.done = threading.Event()
+
+    def send_locked(self, data):
+        with self.lock:
+            self.sock.sendall(data)  # line 29: socket op under lock
+
+    def wait_locked(self):
+        with self.lock:
+            self.done.wait()  # line 33: untimed wait under lock
+
+    def join_locked(self):
+        with self.lock:
+            self.thread.join()  # line 37: unbounded join under lock
+
+    def sleep_locked(self):
+        with self.lock:
+            time.sleep(0.1)  # line 41: sleep under lock
+
+    def indirect_locked(self, data):
+        with self.lock:
+            _push(self.sock, data)  # line 45: sendall via helper
+
+    def launch_locked(self, x):
+        with self.lock:
+            return _scan(x)  # line 49: jitted launch under lock

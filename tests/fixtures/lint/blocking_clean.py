@@ -1,0 +1,45 @@
+"""blocking-under-lock known-clean fixture: bounded waits/joins are
+fine anywhere; blocking ops happen after the lock is released; the
+launch runs outside the critical section."""
+
+import threading
+import time
+
+import jax
+
+
+@jax.jit
+def _scan(x):
+    return x
+
+
+class Conn:
+    def __init__(self, sock, thread):
+        self.sock = sock
+        self.thread = thread
+        self.lock = threading.Lock()
+        self.done = threading.Event()
+        self.queue = []
+
+    def send_unlocked(self, data):
+        with self.lock:
+            payload = bytes(data)  # snapshot under the lock ...
+        self.sock.sendall(payload)  # ... blocking write outside it
+
+    def wait_bounded(self):
+        with self.lock:
+            self.done.wait(0.5)  # timeout: bounded, legal under the lock
+
+    def join_bounded(self):
+        with self.lock:
+            self.thread.join(timeout=1.0)
+
+    def sleep_outside(self):
+        time.sleep(0.1)
+        with self.lock:
+            return len(self.queue)
+
+    def launch_outside(self, x):
+        with self.lock:
+            arg = x
+        return _scan(arg)

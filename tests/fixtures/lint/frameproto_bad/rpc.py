@@ -1,0 +1,55 @@
+"""frame-protocol known-bad fixture (protocol module): a duplicated
+wire value, an unregistered tagged kind, a dead kind, and a client pack
+site whose arity the paired server over-unpacks."""
+
+KIND_CALL = 0
+KIND_RESULT = 1
+KIND_ERROR = 2
+KIND_CLOSE = 3
+KIND_BUSY = 4
+KIND_PROGRESS = 4  # line 10: reuses wire value 4 (KIND_BUSY)
+KIND_RESULT_MUX = 5
+KIND_ERROR_MUX = 6  # line 12: tagged kind missing from MUX_RESPONSE_KINDS
+KIND_PING = 7  # line 13: defined, never sent/dispatched/registered
+
+MUX_RESPONSE_KINDS = {KIND_RESULT: KIND_RESULT_MUX}
+_MUX_TO_BASE = {v: k for k, v in MUX_RESPONSE_KINDS.items()}
+
+
+def pack_frame(kind, obj=None):
+    return [bytes([kind])]
+
+
+def send_frame(sock, kind, obj=None):
+    for part in pack_frame(kind, obj):
+        sock.sendall(part)
+
+
+def recv_frame(sock):
+    return sock.recv(1)[0], None
+
+
+class Client:
+    def call(self, fname, args):
+        # 2-element CALL payload; the server unpacks three
+        send_frame(self.sock, KIND_CALL, (fname, args))
+        kind, payload = recv_frame(self.sock)
+        return self._interpret(kind, payload)
+
+    def close(self):
+        send_frame(self.sock, KIND_CLOSE, None)
+
+    def _reader_loop(self, sock):
+        while True:
+            kind, payload = recv_frame(sock)
+            base = _MUX_TO_BASE.get(kind)
+            if base is not None:
+                kind = base
+
+    def _interpret(self, kind, payload):
+        if kind == KIND_RESULT:
+            return payload
+        if kind == KIND_ERROR:
+            raise RuntimeError(payload)
+        # KIND_BUSY and KIND_PROGRESS fall through: unexpected frame kind
+        raise RuntimeError(f"unexpected frame kind {kind}")

@@ -1,0 +1,27 @@
+"""frame-protocol known-bad fixture (paired server): sends kinds the
+client never interprets and unpacks more CALL payload elements than the
+client packs."""
+
+from tests.fixtures.lint.frameproto_bad import rpc
+
+
+class Server:
+    def _one_call(self, conn):
+        kind, payload = rpc.recv_frame(conn)
+        if kind == rpc.KIND_CLOSE:
+            raise SystemExit
+        if kind != rpc.KIND_CALL:
+            raise RuntimeError(f"unexpected frame kind {kind}")
+        fname, args, kwargs = payload  # line 15: 3-way unpack of a 2-tuple
+        try:
+            ret = getattr(self, fname)(*args, **kwargs)
+            rpc.send_frame(conn, rpc.KIND_RESULT, ret)
+        except Exception as e:
+            rpc.send_frame(conn, rpc.KIND_ERROR, str(e))
+
+    def shed(self, conn):
+        rpc.send_frame(conn, rpc.KIND_BUSY, {})  # line 23: client lacks BUSY
+
+    def notify(self, conn):
+        # line 26-27: client never handles PROGRESS at all
+        rpc.send_frame(conn, rpc.KIND_PROGRESS, {"pct": 50})

@@ -1,0 +1,50 @@
+"""frame-protocol known-clean fixture (protocol module): unique wire
+values, a complete mux registration, every kind produced by one side
+consumed by the other, and pack/unpack arities that agree."""
+
+KIND_CALL = 0
+KIND_RESULT = 1
+KIND_ERROR = 2
+KIND_CLOSE = 3
+KIND_RESULT_MUX = 4
+KIND_ERROR_MUX = 5
+
+MUX_RESPONSE_KINDS = {KIND_RESULT: KIND_RESULT_MUX, KIND_ERROR: KIND_ERROR_MUX}
+_MUX_TO_BASE = {v: k for k, v in MUX_RESPONSE_KINDS.items()}
+
+
+def pack_frame(kind, obj=None):
+    return [bytes([kind])]
+
+
+def send_frame(sock, kind, obj=None):
+    for part in pack_frame(kind, obj):
+        sock.sendall(part)
+
+
+def recv_frame(sock):
+    return sock.recv(1)[0], None
+
+
+class Client:
+    def call(self, fname, args, kwargs):
+        send_frame(self.sock, KIND_CALL, (fname, args, kwargs, {"req_id": 0}))
+        kind, payload = recv_frame(self.sock)
+        return self._interpret(kind, payload)
+
+    def close(self):
+        send_frame(self.sock, KIND_CLOSE, None)
+
+    def _reader_loop(self, sock):
+        while True:
+            kind, payload = recv_frame(sock)
+            base = _MUX_TO_BASE.get(kind)
+            if base is not None:
+                kind = base
+
+    def _interpret(self, kind, payload):
+        if kind == KIND_RESULT:
+            return payload
+        if kind == KIND_ERROR:
+            raise RuntimeError(payload)
+        raise RuntimeError(f"unexpected frame kind {kind}")

@@ -1,0 +1,20 @@
+"""frame-protocol known-clean fixture (paired server): dispatches every
+client-sent kind, answers only kinds the client interprets, and slices
+the CALL payload within the packed arity."""
+
+from tests.fixtures.lint.frameproto_clean import rpc
+
+
+class Server:
+    def _one_call(self, conn):
+        kind, payload = rpc.recv_frame(conn)
+        if kind == rpc.KIND_CLOSE:
+            raise SystemExit
+        if kind != rpc.KIND_CALL:
+            raise RuntimeError(f"unexpected frame kind {kind}")
+        fname, args, kwargs = payload[:3]  # meta element stays optional
+        try:
+            ret = getattr(self, fname)(*args, **kwargs)
+            rpc.send_frame(conn, rpc.KIND_RESULT, ret)
+        except Exception as e:
+            rpc.send_frame(conn, rpc.KIND_ERROR, str(e))

@@ -1,0 +1,42 @@
+"""lock-order known-bad fixture: two ABBA cycles — one purely lexical
+(nested withs in opposite orders), one through a cross-function call
+edge (a method that acquires under the hood)."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+        self.items = []
+
+    def ab(self):
+        with self.a:
+            with self.b:  # line 16: edge Pair.a -> Pair.b
+                return list(self.items)
+
+    def ba(self):
+        with self.b:
+            with self.a:  # line 21: edge Pair.b -> Pair.a — cycle
+                self.items.append(1)
+
+
+class CrossPair:
+    def __init__(self):
+        self.x = threading.Lock()
+        self.y = threading.Lock()
+        self.n = 0
+
+    def _locked_y(self):
+        with self.y:
+            self.n += 1
+
+    def xy(self):
+        with self.x:
+            self._locked_y()  # line 37: call edge CrossPair.x -> CrossPair.y
+
+    def yx(self):
+        with self.y:
+            with self.x:  # line 41: edge CrossPair.y -> CrossPair.x — cycle
+                self.n += 1

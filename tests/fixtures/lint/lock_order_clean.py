@@ -1,0 +1,30 @@
+"""lock-order known-clean fixture: one global acquisition order
+(a before b, everywhere), sequential (non-nested) acquisitions, and a
+call edge consistent with the lexical order."""
+
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+        self.items = []
+
+    def _locked_b(self):
+        with self.b:
+            self.items.append(0)
+
+    def both(self):
+        with self.a, self.b:  # a -> b, the one global order
+            return list(self.items)
+
+    def via_call(self):
+        with self.a:
+            self._locked_b()  # a -> b again: same direction, no cycle
+
+    def sequential(self):
+        with self.b:
+            n = len(self.items)
+        with self.a:  # not nested: b released before a — no b -> a edge
+            return n

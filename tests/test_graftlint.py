@@ -110,6 +110,108 @@ def test_pickle_clean_fixture():
     assert _lint(f"{FIX}/parallel/pickle_clean.py") == []
 
 
+def test_lock_order_bad_fixture():
+    assert _locs(_lint(f"{FIX}/lock_order_bad.py")) == [
+        ("lock-order", 16),  # Pair.a -> Pair.b vs Pair.b -> Pair.a
+        ("lock-order", 37),  # CrossPair cycle through a call edge
+    ]
+
+
+def test_lock_order_clean_fixture():
+    assert _lint(f"{FIX}/lock_order_clean.py") == []
+
+
+def test_lock_order_finding_carries_full_chain():
+    findings = _lint(f"{FIX}/lock_order_bad.py")
+    msg = next(f.message for f in findings if f.line == 16)
+    assert "Pair.a -> Pair.b" in msg and "Pair.b -> Pair.a" in msg
+    assert "lock_order_bad.py:21" in msg  # the closing edge's provenance
+    msg = next(f.message for f in findings if f.line == 37)
+    assert "calls CrossPair._locked_y" not in msg  # chain names locks, not calls
+    assert "CrossPair.x -> CrossPair.y" in msg
+
+
+def test_blocking_bad_fixture():
+    assert _locs(_lint(f"{FIX}/blocking_bad.py")) == [
+        ("blocking-under-lock", 29),  # sendall under lock
+        ("blocking-under-lock", 33),  # untimed Event.wait under lock
+        ("blocking-under-lock", 37),  # unbounded Thread.join under lock
+        ("blocking-under-lock", 41),  # time.sleep under lock
+        ("blocking-under-lock", 45),  # sendall hidden one helper down
+        ("blocking-under-lock", 49),  # jitted launch under lock
+    ]
+
+
+def test_blocking_clean_fixture():
+    assert _lint(f"{FIX}/blocking_clean.py") == []
+
+
+def test_blocking_flags_later_with_item(tmp_path):
+    """Multi-item withs evaluate later context expressions AFTER earlier
+    locks are acquired: `with self.lock, sock.accept():` blocks under
+    the lock and must be flagged (regression for the walker passing the
+    pre-with held set to later items)."""
+    src = (
+        "import threading\n"
+        "\n"
+        "class C:\n"
+        "    def __init__(self, sock):\n"
+        "        self.lock = threading.Lock()\n"
+        "        self.sock = sock\n"
+        "\n"
+        "    def acc(self):\n"
+        "        with self.lock, self.sock.accept() as conn:\n"
+        "            return conn\n"
+    )
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    findings = lint_paths([str(p)])
+    assert [(f.rule, f.line) for f in findings] == [
+        ("blocking-under-lock", 9)]
+
+
+def test_frameproto_bad_fixture():
+    locs = sorted((f.rule, os.path.basename(f.path), f.line)
+                  for f in _lint(f"{FIX}/frameproto_bad"))
+    assert locs == [
+        ("frame-protocol", "rpc.py", 10),     # duplicate wire value
+        ("frame-protocol", "rpc.py", 12),     # unregistered tagged kind
+        ("frame-protocol", "rpc.py", 13),     # dead kind
+        ("frame-protocol", "server.py", 15),  # CALL arity over-unpack
+        ("frame-protocol", "server.py", 23),  # KIND_BUSY unhandled by client
+        ("frame-protocol", "server.py", 27),  # KIND_PROGRESS unhandled
+    ]
+
+
+def test_frameproto_clean_fixture():
+    assert _lint(f"{FIX}/frameproto_clean") == []
+
+
+def test_stale_pins_fail_the_repo_lint(monkeypatch):
+    """The frame-protocol stale-pin audit: drift in the reviewed PINS map
+    (class gone, attribute gone, lock gone) turns into findings anchored
+    at the checks/locks.py entry."""
+    from tools.graftlint.checks import locks as locks_mod
+
+    doctored = dict(locks_mod.PINS)
+    doctored[("GhostClass", "x")] = "lck"              # class missing
+    doctored[("Index", "phantom_attr")] = "index_lock"  # attr missing
+    doctored[("IndexServer", "phantom2")] = "phantom_lock"  # attr AND lock
+    monkeypatch.setattr(locks_mod, "PINS", doctored)
+    stale = [f for f in _lint("distributed_faiss_tpu")
+             if "stale pin" in f.message]
+    assert len(stale) == 4
+    assert {f.rule for f in stale} == {"frame-protocol"}
+    assert all(f.path.endswith("checks/locks.py") for f in stale)
+
+
+def test_pins_all_resolve_today():
+    """The PR 7 audit result, pinned: every hand-maintained PINS entry
+    currently resolves (no findings from the stale-pin audit)."""
+    assert [f for f in _lint("distributed_faiss_tpu")
+            if "stale pin" in f.message] == []
+
+
 def test_suppression_silences_bad_fixture(tmp_path):
     src = open(os.path.join(REPO, FIX, "parallel", "pickle_bad.py")).read()
     sub = tmp_path / "parallel"
@@ -162,5 +264,13 @@ def test_cli_list_rules():
     proc = _cli("--list-rules")
     assert proc.returncode == 0
     for rule in ("host-sync", "recompile-hazard", "dtype-discipline",
-                 "lock-discipline", "pallas-guard", "pickle-safety"):
+                 "lock-discipline", "lock-order", "blocking-under-lock",
+                 "frame-protocol", "pallas-guard", "pickle-safety"):
         assert rule in proc.stdout
+
+
+def test_all_nine_checkers_registered():
+    from tools.graftlint import checks
+
+    assert len(checks.ALL) == 9
+    assert len(checks.RULES) == 9

@@ -1,0 +1,281 @@
+"""Runtime lockdep witness tests (marker ``lockdep``; the subprocess
+tier re-runs are additionally ``slow``).
+
+Unit layer: the DFT_LOCKDEP=1 factories detect cycle-forming
+acquisition edges (ABBA and longer), self-deadlocks, keep per-thread
+held-sets isolated, release Condition keys across ``wait``, and are
+plain threading primitives when disabled.
+
+Tier layer (``pytest -m lockdep``, mirrored by the ci.yml ``lockdep``
+job): re-run the scheduler, rpc-mux, and mesh-serving suites with the
+witness on — every pinned lock in those paths is instrumented, so any
+dynamic lock-order inversion the static lock-order checker cannot see
+fails loudly instead of hanging a rank.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.utils import lockdep
+
+pytestmark = pytest.mark.lockdep
+
+
+@pytest.fixture
+def witness(monkeypatch):
+    monkeypatch.setenv("DFT_LOCKDEP", "1")
+    lockdep.reset()
+    yield
+    lockdep.reset()
+
+
+# ------------------------------------------------------------------ factories
+
+def test_disabled_returns_plain_primitives(monkeypatch):
+    monkeypatch.delenv("DFT_LOCKDEP", raising=False)
+    assert not lockdep.enabled()
+    lk = lockdep.lock("X.lk")
+    assert type(lk) is type(threading.Lock())
+    assert type(lockdep.rlock("X.rlk")) is type(threading.RLock())
+    assert isinstance(lockdep.condition("X.cond"), threading.Condition)
+
+
+def test_enabled_reads_env(witness):
+    assert lockdep.enabled()
+    lk = lockdep.lock("X.lk")
+    assert isinstance(lk, lockdep._DepLock)
+    with lk:
+        assert lockdep.held() == ("X.lk",)
+    assert lockdep.held() == ()
+
+
+# ------------------------------------------------------------ cycle detection
+
+def test_abba_cycle_raises(witness):
+    a, b = lockdep.lock("T.a"), lockdep.lock("T.b")
+    with a:
+        with b:
+            pass
+    assert ("T.a", "T.b") in lockdep.edges()
+    with b:
+        with pytest.raises(lockdep.LockOrderError, match="T.a"):
+            a.acquire()
+
+
+def test_abba_across_threads_is_caught(witness):
+    """The deliberate ABBA-deadlock fixture: thread 1 records a->b,
+    thread 2 attempts b->a and must get LockOrderError instead of a
+    deadlock (the check runs BEFORE blocking)."""
+    a, b = lockdep.lock("AB.a"), lockdep.lock("AB.b")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    errors = []
+
+    def t2():
+        try:
+            with b:
+                with a:
+                    pass  # pragma: no cover - must raise before here
+        except lockdep.LockOrderError as e:
+            errors.append(e)
+
+    th1 = threading.Thread(target=t1)
+    th1.start()
+    th1.join()
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join()
+    assert len(errors) == 1
+    msg = str(errors[0])
+    assert "AB.a" in msg and "AB.b" in msg and "cycle" in msg
+
+
+def test_three_lock_cycle_chain_in_message(witness):
+    a, b, c = (lockdep.lock("C.a"), lockdep.lock("C.b"), lockdep.lock("C.c"))
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with pytest.raises(lockdep.LockOrderError) as exc:
+            a.acquire()
+    msg = str(exc.value)
+    assert "C.a -> C.b" in msg and "C.b -> C.c" in msg
+
+
+def test_self_deadlock_raises(witness):
+    a = lockdep.lock("S.a")
+    with a:
+        with pytest.raises(lockdep.LockOrderError, match="re-acquires"):
+            a.acquire()
+    # the failed acquire must not corrupt the held list
+    assert lockdep.held() == ()
+
+
+def test_rlock_reentry_is_legal(witness):
+    r = lockdep.rlock("R.r")
+    with r:
+        with r:
+            assert lockdep.held() == ("R.r",)
+    assert lockdep.held() == ()
+
+
+def test_consistent_order_never_raises(witness):
+    a, b = lockdep.lock("OK.a"), lockdep.lock("OK.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockdep.edges().keys() == {("OK.a", "OK.b")}
+
+
+# ------------------------------------------------------- held-set bookkeeping
+
+def test_held_sets_are_per_thread(witness):
+    a = lockdep.lock("H.a")
+    seen = {}
+
+    def other():
+        seen["held"] = lockdep.held()
+
+    with a:
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert lockdep.held() == ("H.a",)
+    assert seen["held"] == ()
+
+
+def test_condition_wait_releases_key(witness):
+    cond = lockdep.condition("W.cond")
+    with cond:
+        assert lockdep.held() == ("W.cond",)
+        # wait() releases the underlying lock: the key must leave the
+        # held set for the duration and come back after the timeout
+        assert cond.wait(0.01) is False
+        assert lockdep.held() == ("W.cond",)
+    assert lockdep.held() == ()
+
+
+def test_condition_wait_unowned_does_not_corrupt_held(witness):
+    """wait() without holding the condition must raise threading's own
+    RuntimeError and leave the held list untouched (regression: the old
+    finally re-added a phantom key, poisoning every later acquisition
+    on the thread)."""
+    cond = lockdep.condition("W.unowned")
+    with pytest.raises(RuntimeError):
+        cond.wait(0.01)
+    assert lockdep.held() == ()
+    with cond:  # the witness stays usable afterwards
+        assert lockdep.held() == ("W.unowned",)
+    assert lockdep.held() == ()
+
+
+def test_reset_clears_edges(witness):
+    a, b = lockdep.lock("RS.a"), lockdep.lock("RS.b")
+    with a:
+        with b:
+            pass
+    assert lockdep.edges()
+    lockdep.reset()
+    assert lockdep.edges() == {}
+
+
+def test_error_in_one_thread_leaves_witness_usable(witness):
+    a, b = lockdep.lock("E.a"), lockdep.lock("E.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(lockdep.LockOrderError):
+            a.acquire()
+    # the consistent order still works afterwards
+    with a:
+        with b:
+            pass
+
+
+# -------------------------------------------------------------- integrations
+
+def test_scheduler_runs_clean_under_witness(witness):
+    """The serving scheduler's real lock choreography (condition +
+    per-request events + batcher thread) must record no cycle."""
+    from distributed_faiss_tpu.serving.scheduler import SearchScheduler
+    from distributed_faiss_tpu.utils.config import SchedulerCfg
+
+    def search_fn(index_id, q, k, emb):
+        return (np.zeros((q.shape[0], k), np.float32),
+                [[None] * k for _ in range(q.shape[0])], None)
+
+    sched = SearchScheduler(search_fn, SchedulerCfg(max_wait_ms=1.0))
+    try:
+        q = np.zeros((2, 4), np.float32)
+        out = sched.submit("idx", q, 3)
+        assert out[0].shape == (2, 3)
+    finally:
+        sched.stop()
+    es = set(lockdep.edges())
+    assert not [e for e in es if (e[1], e[0]) in es]  # no 2-cycles recorded
+
+
+def test_engine_locks_are_instrumented(witness):
+    from distributed_faiss_tpu.engine import Index
+    from distributed_faiss_tpu.utils.config import IndexCfg
+
+    idx = Index(IndexCfg(dim=8, index_builder_type="flat"))
+    assert isinstance(idx.buffer_lock, lockdep._DepLock)
+    assert isinstance(idx.index_lock, lockdep._DepLock)
+    idx.add_batch(np.zeros((4, 8), np.float32), None,
+                  train_async_if_triggered=False)
+    # buffer_lock and index_lock both taken; only the designed
+    # buffer->index edge (or none) may exist — never the reverse
+    assert ("Index.index_lock", "Index.buffer_lock") not in lockdep.edges()
+
+
+# ------------------------------------------------------------------ the tier
+
+@pytest.mark.slow
+def test_scheduler_and_rpcmux_suites_under_witness():
+    """The lockdep-tier satellite: re-run the scheduler + rpc-mux suites
+    with DFT_LOCKDEP=1 — every pinned lock in the serving path runs
+    instrumented, so a dynamic lock-order inversion fails the suite."""
+    env = dict(os.environ, DFT_LOCKDEP="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_scheduler.py",
+         "tests/test_scheduler_identity.py", "tests/test_rpc_mux.py",
+         "-q", "-m", "not slow", "-p", "no:cacheprovider"],
+        env=env, capture_output=True, text=True, timeout=1200,
+    )
+    assert proc.returncode == 0, (
+        f"lockdep scheduler/rpcmux tier failed:\n{proc.stdout[-4000:]}\n"
+        f"{proc.stderr[-2000:]}"
+    )
+
+
+@pytest.mark.slow
+def test_mesh_serving_suite_under_witness():
+    """Mesh-serving under the witness, on the virtual 8-device CPU mesh
+    (the scheduler->engine->mesh one-launch path holds index_lock around
+    the pjit dispatch by design — the witness proves it stays acyclic)."""
+    env = dict(os.environ, DFT_LOCKDEP="1", JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q",
+         "-m", "mesh and not slow", "-p", "no:cacheprovider"],
+        env=env, capture_output=True, text=True, timeout=1200,
+    )
+    assert proc.returncode == 0, (
+        f"lockdep mesh tier failed:\n{proc.stdout[-4000:]}\n"
+        f"{proc.stderr[-2000:]}"
+    )

@@ -1,14 +1,18 @@
 """Checker registry. Each checker module exposes RULE and check(model)."""
 
 from tools.graftlint.checks import (
+    blocking,
     dtype,
+    frame_protocol,
     host_sync,
+    lock_order,
     locks,
     pallas_guard,
     pickle_safety,
     recompile,
 )
 
-ALL = (host_sync, recompile, dtype, locks, pallas_guard, pickle_safety)
+ALL = (host_sync, recompile, dtype, locks, lock_order, blocking,
+       frame_protocol, pallas_guard, pickle_safety)
 
 RULES = {c.RULE: c for c in ALL}

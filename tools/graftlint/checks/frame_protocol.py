@@ -1,0 +1,388 @@
+"""frame-protocol: wire-protocol exhaustiveness + pinned-lock-map audit.
+
+The RPC frame protocol (parallel/rpc.py) is a closed-world contract:
+every ``KIND_*`` constant a peer can put on the wire must be decoded by
+the other side, or the receiver tears the connection down at runtime on
+"unexpected frame kind" — in production, against a live peer. This
+checker proves the contract statically, per protocol module (a module
+named ``rpc.py`` defining ``KIND_*`` constants, paired with the
+``server.py`` in the same directory):
+
+- **kind uniqueness** — two kinds sharing a wire value desync every
+  dispatch table;
+- **mux registration** — every ``KIND_*_MUX`` tagged kind must be a
+  value of ``MUX_RESPONSE_KINDS`` (the demux unwraps via its inverse,
+  ``_MUX_TO_BASE``; an unregistered tagged kind is undecodable);
+- **exhaustive dispatch** — a kind the server produces (referenced in
+  the server module outside its ``_one_call`` dispatcher, plus the mux
+  variant of every base kind where the server writes tagged responses)
+  must be consumed by the client (``Client._interpret`` or the
+  ``_reader_loop`` demux); a kind the client produces (referenced in the
+  client class outside those consumers) must be consumed by
+  ``_one_call``;
+- **payload arity** — the ``KIND_CALL`` tuple literal at client pack
+  sites must satisfy the server's unpack of the decoded payload (an
+  unguarded ``a, b, c = payload`` against a 4-element frame is a
+  ValueError on every call; ``payload[:3]`` must not slice more than the
+  smallest pack site provides);
+- **dead kinds** — a kind defined but never referenced again is wiring
+  someone forgot to finish;
+- **stale pins** — every entry of the lock-discipline ``PINS`` map
+  (checks/locks.py, the reviewed allowlist) must resolve: the named
+  class exists, the attribute is actually assigned in it, and the lock
+  is a real lock attribute of that class. A pin that stops resolving is
+  a checker silently switched off — the drift this rule exists to fail
+  CI on. (Audited for PR 7: every PR 3-6 hand-pinned entry currently
+  resolves.) Runs only when the linted set contains the real package
+  (engine.py + parallel/rpc.py), so fixture lints stay quiet.
+"""
+
+import ast
+import os
+import re
+from collections import defaultdict
+
+from tools.graftlint.core import Finding, lock_attrs
+
+RULE = "frame-protocol"
+
+_KIND_RE = re.compile(r"^KIND_[A-Z0-9_]+$")
+_PACK_KIND_ARG = {"pack_frame": 0, "send_frame": 1, "pack_tagged_response": 0}
+
+
+def _kind_ref(node, kinds):
+    """Kind name when ``node`` references one (bare Name or ``mod.KIND_X``
+    attribute), else None."""
+    if isinstance(node, ast.Name) and node.id in kinds:
+        return node.id
+    if isinstance(node, ast.Attribute) and node.attr in kinds:
+        return node.attr
+    return None
+
+
+def _collect_kinds(mod):
+    """Module-level ``KIND_X = <int>`` constants: {name: (value, line)}."""
+    kinds = {}
+    dups = []
+    for stmt in mod.tree.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1):
+            continue
+        t = stmt.targets[0]
+        if not (isinstance(t, ast.Name) and _KIND_RE.match(t.id)):
+            continue
+        if not (isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, int)):
+            continue
+        val = stmt.value.value
+        for name, (v, _ln) in kinds.items():
+            if v == val:
+                dups.append((t.id, name, val, stmt.lineno))
+        kinds[t.id] = (val, stmt.lineno)
+    return kinds, dups
+
+
+def _mux_map(mod, kinds):
+    """{base kind name: mux kind name} from the module-level
+    ``MUX_RESPONSE_KINDS`` dict literal, or None when absent."""
+    for stmt in mod.tree.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1):
+            continue
+        t = stmt.targets[0]
+        if not (isinstance(t, ast.Name) and t.id == "MUX_RESPONSE_KINDS"):
+            continue
+        if not isinstance(stmt.value, ast.Dict):
+            return None
+        out = {}
+        for k, v in zip(stmt.value.keys, stmt.value.values):
+            kn, vn = _kind_ref(k, kinds), _kind_ref(v, kinds)
+            if kn and vn:
+                out[kn] = vn
+        return out
+    return None
+
+
+def _refs_in(node, kinds):
+    """(kind name, line) for every kind reference under ``node``."""
+    for sub in ast.walk(node):
+        name = _kind_ref(sub, kinds)
+        if name is not None and not (
+                isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store)):
+            yield name, sub.lineno
+
+
+def _functions_named(mod, name):
+    return [f for f in mod.functions if f.name == name]
+
+
+def check(model):
+    yield from _check_protocols(model)
+    yield from _check_pins(model)
+
+
+# ------------------------------------------------------------------ protocol
+
+def _check_protocols(model):
+    servers_by_dir = {}
+    for mod in model.modules:
+        if os.path.basename(mod.relpath) == "server.py":
+            servers_by_dir[os.path.dirname(mod.relpath)] = mod
+
+    for mod in model.modules:
+        if os.path.basename(mod.relpath) != "rpc.py":
+            continue
+        kinds, dups = _collect_kinds(mod)
+        if not kinds:
+            continue
+        for dup_name, first_name, val, line in dups:
+            yield Finding(
+                RULE, mod.relpath, line, 0,
+                f"frame kind {dup_name} reuses wire value {val} already "
+                f"taken by {first_name} — kinds must be unique",
+            )
+
+        mux = _mux_map(mod, kinds)
+        mux_values = set(mux.values()) if mux else set()
+        mux_reported = set()
+        for name, (_val, line) in sorted(kinds.items()):
+            if name.endswith("_MUX") and name not in mux_values:
+                mux_reported.add(name)
+                yield Finding(
+                    RULE, mod.relpath, line, 0,
+                    f"tagged kind {name} is not registered in "
+                    "MUX_RESPONSE_KINDS — the demux reader cannot unwrap "
+                    "it (_MUX_TO_BASE is its inverse)",
+                )
+
+        # --- locate the client class and its consumer methods ----------
+        client_cls = None
+        for cnode in mod.classes:
+            if any(isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and s.name == "_interpret" for s in cnode.body):
+                client_cls = cnode
+                break
+        client_consumed = set()
+        client_produced = {}  # kind -> first producing line
+        demux_unwraps_mux = False
+        if client_cls is not None:
+            for sub in client_cls.body:
+                if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                refs = list(_refs_in(sub, kinds))
+                if sub.name in ("_interpret", "_reader_loop"):
+                    client_consumed |= {n for n, _ln in refs}
+                    if sub.name == "_reader_loop":
+                        for n2 in ast.walk(sub):
+                            if (isinstance(n2, ast.Name) and n2.id in
+                                    ("_MUX_TO_BASE", "MUX_RESPONSE_KINDS")):
+                                demux_unwraps_mux = True
+                else:
+                    for n, ln in refs:
+                        client_produced.setdefault(n, ln)
+        if demux_unwraps_mux:
+            client_consumed |= mux_values
+
+        # --- the paired server module ----------------------------------
+        server = servers_by_dir.get(os.path.dirname(mod.relpath))
+        server_consumed = set()
+        server_produced = {}
+        server_writes_tagged = False
+        if server is not None:
+            one_call = _functions_named(server, "_one_call")
+            for f in one_call:
+                server_consumed |= {n for n, _ln in _refs_in(f.node, kinds)}
+            one_call_ids = {id(f.node) for f in one_call}
+            for f in server.functions:
+                if id(f.node) in one_call_ids:
+                    continue
+                for n, ln in _refs_in(f.node, kinds):
+                    server_produced.setdefault(n, ln)
+                for sub in ast.walk(f.node):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "pack_tagged_response"):
+                        server_writes_tagged = True
+            if server_writes_tagged and mux:
+                for base, ln in list(server_produced.items()):
+                    if base in mux:
+                        server_produced.setdefault(mux[base], ln)
+
+            for name in sorted(server_produced):
+                if name in server_consumed:
+                    continue  # also dispatched server-side (e.g. CALL echo)
+                if name not in client_consumed:
+                    yield Finding(
+                        RULE, server.relpath, server_produced[name], 0,
+                        f"server sends {name} but the client never handles "
+                        "it (neither _interpret nor the demux reader) — "
+                        "the connection dies with 'unexpected frame kind' "
+                        "at runtime",
+                    )
+            for name in sorted(client_produced):
+                if name in client_consumed:
+                    continue
+                if name not in server_consumed:
+                    yield Finding(
+                        RULE, mod.relpath, client_produced[name], 0,
+                        f"client sends {name} but the server's _one_call "
+                        "dispatcher never handles it",
+                    )
+
+            yield from _check_call_arity(mod, server, kinds, client_cls)
+
+        # --- dead kinds -------------------------------------------------
+        referenced = set()
+        for m in (mod, server) if server is not None else (mod,):
+            for stmt in m.tree.body:
+                # definition sites never appear here: _refs_in already
+                # excludes Store-context names, so every hit is a load
+                for n, _ln in _refs_in(stmt, kinds):
+                    referenced.add(n)
+        for name, (_val, line) in sorted(kinds.items()):
+            if name not in referenced and name not in mux_reported:
+                yield Finding(
+                    RULE, mod.relpath, line, 0,
+                    f"frame kind {name} is defined but never sent, "
+                    "dispatched, or registered — dead protocol surface",
+                )
+
+
+def _check_call_arity(mod, server, kinds, client_cls):
+    """KIND_CALL pack-site tuple arities vs the server's payload unpack."""
+    if "KIND_CALL" not in kinds:
+        return
+    arities = {}  # arity -> line (first site)
+    scope = client_cls if client_cls is not None else mod.tree
+    for sub in ast.walk(scope):
+        if not isinstance(sub, ast.Call):
+            continue
+        fn = sub.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        pos = _PACK_KIND_ARG.get(name)
+        if pos is None or len(sub.args) <= pos:
+            continue
+        if _kind_ref(sub.args[pos], kinds) != "KIND_CALL":
+            continue
+        if len(sub.args) > pos + 1 and isinstance(sub.args[pos + 1], ast.Tuple):
+            arity = len(sub.args[pos + 1].elts)
+            arities.setdefault(arity, sub.lineno)
+    if not arities:
+        return
+    lo = min(arities)
+    for f in _functions_named(server, "_one_call"):
+        payload_var = None
+        for sub in ast.walk(f.node):
+            if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
+                continue
+            t, v = sub.targets[0], sub.value
+            if (payload_var is None and isinstance(t, ast.Tuple)
+                    and len(t.elts) == 2
+                    and isinstance(v, ast.Call)
+                    and ((isinstance(v.func, ast.Attribute)
+                          and v.func.attr == "recv_frame")
+                         or (isinstance(v.func, ast.Name)
+                             and v.func.id == "recv_frame"))
+                    and isinstance(t.elts[1], ast.Name)):
+                payload_var = t.elts[1].id
+                continue
+            if payload_var is None or not isinstance(t, ast.Tuple):
+                continue
+            n_targets = len(t.elts)
+            if isinstance(v, ast.Name) and v.id == payload_var:
+                if any(a != n_targets for a in arities):
+                    bad = sorted(a for a in arities if a != n_targets)
+                    yield Finding(
+                        RULE, server.relpath, sub.lineno, 0,
+                        f"_one_call unpacks exactly {n_targets} elements "
+                        f"from the KIND_CALL payload, but a client pack "
+                        f"site sends {bad[0]} "
+                        f"({mod.relpath}:{arities[bad[0]]}) — slice the "
+                        "payload to stay wire-compatible",
+                    )
+            elif (isinstance(v, ast.Subscript)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == payload_var
+                    and isinstance(v.slice, ast.Slice)
+                    and v.slice.lower is None
+                    and isinstance(v.slice.upper, ast.Constant)):
+                n_slice = v.slice.upper.value
+                if n_slice > lo:
+                    yield Finding(
+                        RULE, server.relpath, sub.lineno, 0,
+                        f"_one_call slices {n_slice} elements from the "
+                        f"KIND_CALL payload, but a client pack site sends "
+                        f"only {lo} ({mod.relpath}:{arities[lo]})",
+                    )
+
+
+# ------------------------------------------------------------------ pin audit
+
+def _check_pins(model):
+    """Every PINS entry must resolve against the linted classes; only
+    meaningful when the real package is in the model (fixture lints
+    skip)."""
+    has_engine = any(m.relpath.endswith("engine.py")
+                     and "fixtures" not in m.relpath for m in model.modules)
+    has_rpc = any(m.relpath.endswith("parallel/rpc.py") for m in model.modules)
+    if not (has_engine and has_rpc):
+        return
+
+    from tools.graftlint.checks import locks as locks_mod
+
+    pins_path = os.path.relpath(locks_mod.__file__).replace(os.sep, "/")
+    try:
+        with open(locks_mod.__file__, "r", encoding="utf-8") as f:
+            pins_lines = f.read().splitlines()
+    except OSError:  # pragma: no cover - the module was importable
+        pins_lines = []
+
+    def pin_line(cls, attr):
+        needle = f'("{cls}", "{attr}")'
+        for i, text in enumerate(pins_lines, 1):
+            if needle in text:
+                return i
+        return 1
+
+    classes = defaultdict(list)
+    for mod in model.modules:
+        if "fixtures" in mod.relpath:
+            continue
+        for node in mod.classes:
+            classes[node.name].append(node)
+
+    for (cls, attr), lock in sorted(locks_mod.PINS.items()):
+        nodes = classes.get(cls)
+        if not nodes:
+            yield Finding(
+                RULE, pins_path, pin_line(cls, attr), 0,
+                f"stale pin: class {cls} (pinned attr `{attr}` under "
+                f"`{lock}`) does not exist in the linted package — remove "
+                "or correct the PINS entry",
+            )
+            continue
+        attr_ok = any(_assigns_self_attr(n, attr) for n in nodes)
+        lock_ok = any(lock in lock_attrs(n) for n in nodes)
+        if not attr_ok:
+            yield Finding(
+                RULE, pins_path, pin_line(cls, attr), 0,
+                f"stale pin: {cls}.{attr} is never assigned in class "
+                f"{cls} — the lock-discipline pin no longer guards "
+                "anything",
+            )
+        if not lock_ok:
+            yield Finding(
+                RULE, pins_path, pin_line(cls, attr), 0,
+                f"stale pin: {cls}.{lock} is not a lock attribute of "
+                f"{cls} (neither a threading primitive nor a lockdep "
+                "factory) — the pinned guard cannot be enforced",
+            )
+
+
+def _assigns_self_attr(class_node, attr):
+    for node in ast.walk(class_node):
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                    and node.attr == attr):
+                return True
+    return False

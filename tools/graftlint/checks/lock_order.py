@@ -1,0 +1,278 @@
+"""lock-order: static lock-acquisition graph, deadlock-cycle findings.
+
+Builds a directed graph over lock *classes* (``ClassName.lock_attr``
+nodes) from three edge sources:
+
+- nested ``with self.A: ... with self.B:`` blocks (edge A -> B);
+- multi-item withs (``with self.A, self.B:`` acquires left to right);
+- cross-function call edges: a call made while holding A, resolved
+  name-based (bare names preferring same-module definitions, plus exact
+  ``self.method()`` dispatch), contributes A -> L for every lock L the
+  callee may TRANSITIVELY acquire.
+
+Any cycle in that graph is a deadlock hazard: two threads walking the
+cycle from different entry points can each hold one lock of the cycle
+while waiting for the next. The finding carries the full acquisition
+chain with the file:line where each edge is created, so the fix (pick
+one global order) is mechanical.
+
+Lexical model matches lock-discipline (checks/locks.py): lambdas inherit
+the surrounding lock context, nested ``def``s reset it, and
+``__init__``/``__new__``/``__del__`` are construction/teardown and
+skipped. Precision-first like every graftlint checker: dynamic dispatch
+(``getattr``, callbacks, function values) is invisible, so zero findings
+is necessary, not sufficient — ``utils/lockdep.py`` (the DFT_LOCKDEP=1
+runtime witness) covers the dynamic half of the same contract.
+"""
+
+import ast
+from collections import defaultdict
+
+from tools.graftlint.core import (
+    Finding,
+    HOT_EDGE_STOPLIST,
+    lock_attrs,
+    lock_context_events,
+)
+
+RULE = "lock-order"
+
+_SKIP_METHODS = frozenset({"__init__", "__new__", "__del__"})
+
+
+def _class_lock_names(model):
+    """{(module, class_name): set of lock attrs} for every linted class,
+    including locks pinned in the reviewed PINS map (so a lock spelled in
+    a way `lock_attrs` cannot see still participates once pinned)."""
+    from tools.graftlint.checks.locks import PINS
+
+    pinned = defaultdict(set)
+    for (cls, _attr), lock in PINS.items():
+        pinned[cls].add(lock)
+    out = {}
+    for mod in model.modules:
+        for node in mod.classes:
+            names = lock_attrs(node) | pinned.get(node.name, set())
+            if names:
+                out[(mod, node.name)] = names
+    return out
+
+
+def _resolve(call, fi, model):
+    """Callees a call site may reach, precision-first: bare names resolve
+    to same-module functions (else a globally unique definition), and
+    ``self.m()`` resolves exactly within the enclosing class. Everything
+    else (attribute calls on other objects, function values) is dynamic
+    dispatch and invisible by design."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        name = f.id
+        if name in HOT_EDGE_STOPLIST:
+            return []
+        cands = model.by_name.get(name, [])
+        same_mod = [g for g in cands if g.module is fi.module]
+        if same_mod:
+            return same_mod
+        return list(cands) if len(cands) == 1 else []
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == "self" and fi.cls is not None):
+        return [
+            g for g in model.by_name.get(f.attr, ())
+            if g.module is fi.module and g.cls == fi.cls
+        ]
+    return []
+
+
+def check(model):
+    class_locks = _class_lock_names(model)
+
+    # per-function: direct lock acquisitions, call sites, and the
+    # acquire/call events needed for edge provenance
+    direct = {}       # id(fi) -> set of lock keys acquired in the body
+    calls = {}        # id(fi) -> [(callee fi, line)]
+    events = {}       # id(fi) -> [("acquire", key, held, line) | ("call", fi, held, line)]
+    fns = {}          # id(fi) -> fi
+    for fi in model.functions:
+        if fi.cls is None or fi.name in _SKIP_METHODS:
+            continue
+        lock_names = class_locks.get((fi.module, fi.cls))
+        if lock_names is None:
+            continue
+        key = lambda attr: f"{fi.cls}.{attr}"  # noqa: E731
+        acq, csites, evs = set(), [], []
+        for ev in lock_context_events(fi.node, lock_names):
+            if ev[0] == "acquire":
+                _, attr, held, node = ev
+                acq.add(key(attr))
+                evs.append(("acquire", key(attr),
+                            tuple(key(h) for h in held), node.lineno))
+            else:
+                _, node, held = ev
+                if isinstance(node, ast.Call):
+                    for g in _resolve(node, fi, model):
+                        csites.append((g, node.lineno))
+                        evs.append(("call", g,
+                                    tuple(key(h) for h in held), node.lineno))
+        fns[id(fi)] = fi
+        direct[id(fi)] = acq
+        calls[id(fi)] = csites
+        events[id(fi)] = evs
+
+    # module-level functions acquire nothing themselves but may call
+    # methods; for transitive-acquire purposes give every remaining
+    # function an (empty-direct) entry with its resolvable calls
+    for fi in model.functions:
+        if id(fi) in fns:
+            continue
+        csites = []
+        for sub in ast.walk(fi.node):
+            if isinstance(sub, ast.Call):
+                for g in _resolve(sub, fi, model):
+                    csites.append((g, sub.lineno))
+        fns[id(fi)] = fi
+        direct.setdefault(id(fi), set())
+        calls[id(fi)] = csites
+
+    # transitive closure: acquires(f) = direct(f) U acquires(callees)
+    trans = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fid, csites in calls.items():
+            for g, _line in csites:
+                add = trans.get(id(g), ())
+                if not set(add) <= trans[fid]:
+                    trans[fid] |= add
+                    changed = True
+
+    # edges: (a, b) -> (path, line, qualname, note); first occurrence wins,
+    # deterministically (functions iterate in file/definition order)
+    edges = {}
+
+    def add_edge(a, b, mod, line, qual, note):
+        if (a, b) not in edges:
+            edges[(a, b)] = (mod.relpath, line, qual, note)
+
+    for fid, evs in events.items():
+        fi = fns[fid]
+        for ev in evs:
+            if ev[0] == "acquire":
+                _, k, held, line = ev
+                for h in held:
+                    add_edge(h, k, fi.module, line, fi.qualname,
+                             f"acquires {k} while holding {h}")
+            else:
+                _, g, held, line = ev
+                if not held:
+                    continue
+                for k in sorted(trans.get(id(g), ())):
+                    for h in held:
+                        add_edge(h, k, fi.module, line, fi.qualname,
+                                 f"calls {g.qualname} (which may acquire "
+                                 f"{k}) while holding {h}")
+
+    # cycle detection: report each strongly connected component with a
+    # cycle (>1 node, or a self-loop) exactly once, with a representative
+    # chain reconstructed inside the SCC
+    adj = defaultdict(set)
+    for a, b in edges:
+        adj[a].add(b)
+    for comp in _sccs(adj):
+        comp_set = set(comp)
+        if len(comp) == 1:
+            n = comp[0]
+            if n not in adj[n]:
+                continue
+            chain = [n, n]
+        else:
+            chain = _cycle_in(sorted(comp_set)[0], comp_set, adj)
+        hops = []
+        for a, b in zip(chain, chain[1:]):
+            path, line, qual, _note = edges[(a, b)]
+            hops.append(f"{a} -> {b} ({path}:{line} in {qual})")
+        anchor = edges[(chain[0], chain[1])]
+        yield Finding(
+            RULE, anchor[0], anchor[1], 0,
+            "lock-order cycle (deadlock hazard): " + "; ".join(hops)
+            + " — pick one global acquisition order",
+        )
+
+
+def _sccs(adj):
+    """Tarjan over the lock graph; deterministic node order."""
+    nodes = sorted(set(adj) | {b for bs in adj.values() for b in bs})
+    index = {}
+    low = {}
+    onstack = set()
+    stack = []
+    out = []
+    counter = [0]
+
+    def strong(v):
+        # iterative Tarjan (the graph is tiny, but recursion depth should
+        # not depend on lock count)
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(sorted(comp))
+
+    for v in nodes:
+        if v not in index:
+            strong(v)
+    return sorted(out)
+
+
+def _cycle_in(start, comp, adj):
+    """A representative cycle through ``start`` within one SCC, as a node
+    chain [start, ..., start]."""
+    # BFS back to start restricted to the component
+    from collections import deque
+
+    parent = {start: None}
+    q = deque([start])
+    while q:
+        v = q.popleft()
+        for w in sorted(adj.get(v, ())):
+            if w not in comp:
+                continue
+            if w == start:
+                path = []
+                node = v
+                while node is not None:
+                    path.append(node)
+                    node = parent[node]
+                return list(reversed(path)) + [start]
+            if w not in parent:
+                parent[w] = v
+                q.append(w)
+    return [start, start]  # pragma: no cover - SCC guarantees a cycle

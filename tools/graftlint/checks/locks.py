@@ -23,7 +23,7 @@ usually run later on another thread, e.g. watcher/worker targets).
 
 import ast
 
-from tools.graftlint.core import Finding
+from tools.graftlint.core import Finding, lock_attrs
 
 RULE = "lock-discipline"
 
@@ -67,22 +67,11 @@ PINS = {
 _SKIP_METHODS = frozenset({"__init__", "__new__", "__del__"})
 
 
-def _lock_attrs(class_node) -> set:
-    """Attributes assigned ``threading.Lock()``/``RLock()``/``Condition()``
-    anywhere in the class body."""
-    locks = set()
-    for node in ast.walk(class_node):
-        if not isinstance(node, ast.Assign):
-            continue
-        v = node.value
-        if not (isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
-                and v.func.attr in ("Lock", "RLock", "Condition")):
-            continue
-        for t in node.targets:
-            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
-                    and t.value.id == "self"):
-                locks.add(t.attr)
-    return locks
+# lock-attr detection lives in core (shared with lock-order,
+# blocking-under-lock, and the frame-protocol stale-pin audit): it
+# recognizes both ``threading.Lock()``-style constructors and the
+# ``lockdep.lock/rlock/condition(...)`` runtime-witness factories.
+_lock_attrs = lock_attrs
 
 
 _MUTATOR_METHODS = frozenset({

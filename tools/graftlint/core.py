@@ -61,6 +61,96 @@ EXTERNAL_ROOTS = frozenset({
 
 NUMPY_ALIASES = frozenset({"np", "numpy"})
 
+# names of the utils.lockdep factory functions: `self.x = lockdep.lock(...)`
+# creates a (possibly instrumented) lock exactly like `threading.Lock()`.
+# Lock detection must recognize both spellings or wiring the runtime
+# witness would silently blind every lock checker (the frame-protocol
+# stale-pin audit exists to catch exactly that class of drift).
+LOCKDEP_FACTORIES = frozenset({"lock", "rlock", "condition"})
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition"})
+
+
+def is_lock_ctor(node: ast.AST) -> bool:
+    """True when ``node`` is a lock-creating call: ``threading.Lock()`` /
+    ``RLock()`` / ``Condition()``, or a ``lockdep.lock/rlock/condition(...)``
+    factory call (utils/lockdep.py — plain primitive when DFT_LOCKDEP is
+    off, instrumented witness when on)."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+        return False
+    if node.func.attr in _LOCK_CTORS:
+        return True
+    return (node.func.attr in LOCKDEP_FACTORIES
+            and attr_root(node.func) == "lockdep")
+
+
+def lock_attrs(class_node) -> set:
+    """Attributes of ``self`` assigned a lock anywhere in the class body
+    (see ``is_lock_ctor`` for what counts as a lock)."""
+    locks = set()
+    for node in ast.walk(class_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not is_lock_ctor(node.value):
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                locks.add(t.attr)
+    return locks
+
+
+def lock_context_events(method_node, lock_names):
+    """Walk one method body under the lock-discipline lexical model,
+    yielding two event kinds:
+
+    - ``("acquire", lock_attr, held_before, node)`` — a ``with
+      self.<lock>:`` item, with the ordered tuple of locks already held
+      lexically at that point (multi-item withs acquire left to right);
+    - ``("node", ast_node, held)`` — every other AST node, with the
+      ordered tuple of locks held around it.
+
+    Lambdas inherit the surrounding lock context (they run inline);
+    nested ``def``s reset it (they usually run later on another thread).
+    """
+
+    def self_lock(expr):
+        if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and expr.attr in lock_names):
+            return expr.attr
+        return None
+
+    def visit(node, held):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            # items evaluate left to right, each AFTER the previous items'
+            # locks are acquired — so a later item's context expression
+            # (e.g. `with self.lock, sock.accept() as c:`) runs with the
+            # earlier locks held
+            new_held = list(held)
+            for item in node.items:
+                attr = self_lock(item.context_expr)
+                if attr is not None:
+                    yield ("acquire", attr, tuple(new_held), item.context_expr)
+                    if attr not in new_held:
+                        new_held.append(attr)
+                else:
+                    yield from visit(item.context_expr, tuple(new_held))
+            for sub in node.body:
+                yield from visit(sub, tuple(new_held))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in node.body:
+                yield from visit(sub, ())  # runs later: no inherited locks
+            return
+        if isinstance(node, ast.Lambda):
+            yield from visit(node.body, held)  # runs inline: inherits locks
+            return
+        yield ("node", node, held)
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, held)
+
+    for stmt in method_node.body:
+        yield from visit(stmt, ())
+
 # method names excluded as hot-path call-graph edges: ubiquitous container/
 # builtin method names that would otherwise alias repo functions (a
 # `seen.add(x)` inside a hot function must not mark every `Index.add` hot —
