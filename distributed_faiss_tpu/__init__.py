@@ -35,6 +35,10 @@ _LAZY = {
     "DeadlineExceeded": ("distributed_faiss_tpu.parallel.rpc", "DeadlineExceeded"),
     "SchedulerCfg": ("distributed_faiss_tpu.utils.config", "SchedulerCfg"),
     "MeshCfg": ("distributed_faiss_tpu.utils.config", "MeshCfg"),
+    "ReplicationCfg": ("distributed_faiss_tpu.utils.config", "ReplicationCfg"),
+    "QuorumError": ("distributed_faiss_tpu.parallel.client", "QuorumError"),
+    "MembershipTable": ("distributed_faiss_tpu.parallel.replication",
+                        "MembershipTable"),
     "SearchScheduler": ("distributed_faiss_tpu.serving.scheduler", "SearchScheduler"),
 }
 

@@ -154,6 +154,12 @@ class Index:
         self.index_lock = lockdep.lock("Index.index_lock")
         self.state = IndexState.NOT_TRAINED
         self.tpu_index = None  # models.base.TpuIndex once trained
+        # set when this engine is replaced in a server's registry (shard
+        # transfer install, drop_index): stops the save watcher and
+        # blocks further autosaves, so a superseded engine can never
+        # commit its stale state as a NEWER generation over the
+        # replacement's storage dir
+        self._retired = threading.Event()
 
         self.index_save_time = time.time()
         self.index_saved_size = 0
@@ -547,7 +553,18 @@ class Index:
             logger.info("index is not trained, skip saving")
             return False
 
+    def retire(self) -> None:
+        """Permanently stop persistence for this engine instance: the
+        save watcher exits and ``_maybe_save`` becomes a no-op. Called
+        when a server swaps this engine out of its registry — the
+        storage dir now belongs to the replacement, and a late autosave
+        from this instance would commit stale state as the newest
+        generation there."""
+        self._retired.set()
+
     def _maybe_save(self, ignore_time: bool = False) -> bool:
+        if self._retired.is_set():
+            return False
         if not ignore_time:
             if self.cfg.save_interval_sec <= 0:
                 return False
@@ -558,16 +575,8 @@ class Index:
             if self.tpu_index is None or self.tpu_index.ntotal == self.index_saved_size:
                 return False
             storage_dir = self.cfg.index_storage_dir
-            os.makedirs(storage_dir, exist_ok=True)
 
-            # torn-snapshot-proof save: every file of this save carries a
-            # fresh generation number (atomic tmp+fsync+rename each), and
-            # the generation only becomes loadable when its MANIFEST — with
-            # per-file sha256 — lands LAST. kill -9 at any byte offset
-            # leaves either the previous committed generation intact or a
-            # complete new one; load verifies checksums and quarantines
-            # anything in between (supersedes the reference's acknowledged
-            # torn-write TODO, index.py:443-446)
+            # torn-snapshot-proof save (the _commit_generation protocol):
             # seed the generation number from BOTH the in-memory counter
             # and the newest generation on disk: a
             # fresh engine over a dir with existing generations (rank
@@ -577,41 +586,134 @@ class Index:
             # would roll back to the stale newest-on-disk generation
             disk_gens = serialization.list_generations(storage_dir)
             gen = max(self._generation, disk_gens[0][0] if disk_gens else 0) + 1
-            plan = {
-                "index": ("npz", "wb",
-                          # graftlint: ok(blocking-under-lock): designed locked fetch — the snapshot must capture index+buffer+meta at one atomic point
-                          lambda f: save_state(f, self.tpu_index.state_dict())),
-                "meta": ("pkl", "wb",
-                         lambda f: pickle.dump(self.id_to_metadata.tolist(), f)),
-                "buffer": ("pkl", "wb",
-                           lambda f: pickle.dump(self.embeddings_buffer, f)),
-                "cfg": ("json", "w",
-                        lambda f: f.write(self.cfg.to_json_string() + "\n")),
-            }
-            entries = {}
-            for key, (ext, mode, write_fn) in plan.items():
-                name = serialization.generation_filename(key, gen, ext)
-                digest = atomic_write(os.path.join(storage_dir, name), write_fn, mode)
-                entries[key] = {"name": name, "sha256": digest}
-            serialization.write_manifest(
-                storage_dir, gen, entries,
+            # graftlint: ok(blocking-under-lock): designed locked fetch — the snapshot must capture index+buffer+meta at one atomic point
+            state = self.tpu_index.state_dict()
+            self._commit_generation(
+                storage_dir, gen, state, self.id_to_metadata.tolist(),
+                self.embeddings_buffer, self.cfg,
                 extra={"ntotal": int(self.tpu_index.ntotal)},
             )
-            # unversioned cfg.json convenience copy: get_config_path readers
-            # (IndexClient.load_index) expect it at a fixed name; it is NOT
-            # part of the committed set
-            atomic_write(
-                os.path.join(storage_dir, "cfg.json"),
-                lambda f: f.write(self.cfg.to_json_string() + "\n"), "w",
-            )
             self._generation = gen
-            serialization.prune_generations(storage_dir, keep=2)
 
             self.index_saved_size = self.tpu_index.ntotal
             self.index_save_time = time.time()
             logger.info("saved index (%d vectors) to %s as generation %d",
                         self.index_saved_size, storage_dir, gen)
             return True
+
+    @staticmethod
+    def _commit_generation(storage_dir: str, gen: int, state: dict,
+                           meta: list, buffer: list, cfg: IndexCfg,
+                           extra: Optional[dict] = None) -> None:
+        """ONE copy of the torn-snapshot commit protocol, shared by the
+        normal save path and the shard-transfer import: every file of
+        generation ``gen`` is written atomically (tmp+fsync+rename), and
+        the generation only becomes loadable when its MANIFEST — with
+        per-file sha256 — lands LAST. kill -9 at any byte offset leaves
+        either the previous committed generation intact or a complete
+        new one; load verifies checksums and quarantines anything in
+        between (supersedes the reference's acknowledged torn-write
+        TODO, index.py:443-446). Also refreshes the unversioned cfg.json
+        convenience copy (get_config_path readers expect the fixed name;
+        it is NOT part of the committed set) and prunes to the newest 2
+        generations."""
+        os.makedirs(storage_dir, exist_ok=True)
+        plan = {
+            "index": ("npz", "wb", lambda f: save_state(f, state)),
+            "meta": ("pkl", "wb", lambda f: pickle.dump(meta, f)),
+            "buffer": ("pkl", "wb", lambda f: pickle.dump(buffer, f)),
+            "cfg": ("json", "w",
+                    lambda f: f.write(cfg.to_json_string() + "\n")),
+        }
+        entries = {}
+        for key, (ext, mode, write_fn) in plan.items():
+            name = serialization.generation_filename(key, gen, ext)
+            digest = atomic_write(os.path.join(storage_dir, name), write_fn, mode)
+            entries[key] = {"name": name, "sha256": digest}
+        serialization.write_manifest(storage_dir, gen, entries, extra=extra)
+        atomic_write(
+            os.path.join(storage_dir, "cfg.json"),
+            lambda f: f.write(cfg.to_json_string() + "\n"), "w",
+        )
+        serialization.prune_generations(storage_dir, keep=2)
+
+    # ------------------------------------------------------- shard transfer
+
+    def export_snapshot(self) -> dict:
+        """The shard-transfer unit for replica join (parallel/replication).
+
+        One atomic capture — index state_dict + full metadata + the
+        not-yet-indexed buffer (the delta a joiner replays through the
+        normal add path) + cfg — taken under both locks, exactly the set
+        a MANIFEST-committed save would write. Shipped over the wire as
+        a KIND_SHARD_DATA frame (ndarrays ride the raw tensor path);
+        ``import_snapshot`` on the receiving rank commits it to disk as
+        a generation of its own before serving, so the transfer inherits
+        the torn-snapshot guarantees of PR 3's persistence layer."""
+        with self.buffer_lock, self.index_lock:
+            # graftlint: ok(blocking-under-lock): designed locked fetch — the transfer snapshot must capture index+buffer+meta at one atomic point (same contract as _maybe_save)
+            state = self.tpu_index.state_dict() if self.tpu_index is not None else None
+            return {
+                "format": 1,
+                "generation": self._generation,
+                "state": state,
+                "state_name": self.state.name,
+                "ntotal": int(self.tpu_index.ntotal) if self.tpu_index is not None else 0,
+                "meta": self.id_to_metadata.tolist(),
+                "buffer": list(self.embeddings_buffer),
+                "cfg_json": self.cfg.to_json_string(),
+            }
+
+    @classmethod
+    def import_snapshot(cls, snapshot: dict, storage_dir: str,
+                        cfg: IndexCfg = None) -> "Index":
+        """Install a transferred shard snapshot on THIS rank.
+
+        A trained snapshot is first committed to ``storage_dir`` as a
+        manifest-committed generation (atomic per-file writes + sha256
+        MANIFEST landing last — the PR 3 commit protocol), so a crash
+        right after the transfer restarts from the transferred shard
+        instead of an empty one; then the engine restores from it and
+        replays the buffer delta through the normal async add path. An
+        untrained snapshot (no index yet) just replays its buffer, which
+        re-triggers training at the configured threshold."""
+        import json as _json
+
+        if cfg is None:
+            kwargs = _json.loads(snapshot["cfg_json"])
+            kwargs.update(kwargs.pop("extra", {}))
+            cfg = IndexCfg(**kwargs)
+        cfg.index_storage_dir = storage_dir
+        meta = list(snapshot.get("meta") or [])
+        buffer = [np.asarray(b, np.float32)
+                  for b in (snapshot.get("buffer") or [])]
+        state = snapshot.get("state")
+        if state is None:
+            # nothing trained at the source: replay the raw buffer
+            result = cls(cfg)
+            offset = 0
+            for chunk in buffer:
+                n = chunk.shape[0]
+                result.add_batch(chunk, meta[offset:offset + n])
+                offset += n
+            return result
+
+        tpu_index = index_from_state_dict(state)
+        disk_gens = serialization.list_generations(storage_dir)
+        gen = max(int(snapshot.get("generation", 0)),
+                  disk_gens[0][0] if disk_gens else 0) + 1
+        cls._commit_generation(
+            storage_dir, gen, state, meta, buffer, cfg,
+            extra={"ntotal": int(tpu_index.ntotal), "transferred": True},
+        )
+        logger.info(
+            "imported transferred shard (%d vectors, %d buffered) into %s "
+            "as generation %d", tpu_index.ntotal,
+            sum(b.shape[0] for b in buffer), storage_dir, gen)
+        result = cls._restore(cfg, tpu_index, meta, buffer)
+        result._generation = gen
+        result.index_saved_size = tpu_index.ntotal
+        return result
 
     @classmethod
     def from_storage_dir(
@@ -738,8 +840,9 @@ class Index:
 
     def _run_save_watcher(self) -> None:
         def _watch(idx: "Index"):
-            while True:
-                time.sleep(idx.cfg.save_interval_sec)
+            # the retired event doubles as the sleep: retire() wakes the
+            # watcher immediately instead of leaking it one last interval
+            while not idx._retired.wait(idx.cfg.save_interval_sec):
                 idx._maybe_save(ignore_time=False)
 
         t = threading.Thread(target=_watch, args=(self,), daemon=True)

@@ -21,6 +21,18 @@ The merge replaces the reference's FAISS C++ ``float_maxheap_array_t``
 (ResultHeap, client.py:29-54) with a numpy concat + argpartition top-k —
 same semantics (min-merge over per-server blocks, dot scores negated before
 merging and returned negated, client.py:282-294), no native heap needed.
+
+Replication (parallel/replication.py, ``ReplicationCfg``): with
+``DFT_REPLICATION`` R > 1 the discovery-order ranks form replica GROUPS
+of R (one logical shard each). Writes fan out to every replica of the
+placed group and ack on a configurable quorum (default majority);
+replicas that missed an acked write land in a bounded repair queue
+(``repair_under_replicated`` re-sends them). Reads fan out to ONE live
+replica per group — transport failures fail over to the next replica and
+pin it — so a SIGKILLed rank costs neither rows nor availability, and
+the heap merge sees exactly one block per logical shard (never a
+duplicate). R=1 (the default) is byte-for-byte the pre-replication
+behavior: one group per rank, quorum 1, reroute-on-death.
 """
 
 import itertools
@@ -28,16 +40,24 @@ import logging
 import os
 import random
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Tuple
 
 import numpy as np
 
-from distributed_faiss_tpu.parallel import rpc
-from distributed_faiss_tpu.utils.config import IndexCfg
+from distributed_faiss_tpu.parallel import replication, rpc
+from distributed_faiss_tpu.utils import lockdep
+from distributed_faiss_tpu.utils.config import IndexCfg, ReplicationCfg
 from distributed_faiss_tpu.utils.state import IndexState
 
 logger = logging.getLogger()
+
+# bound on the reroute ring (satellite of ISSUE 8): a long-lived client
+# must not grow the skip log without bound — the full history lives in
+# the monotonic ``counters``, the ring keeps the most recent records for
+# operator forensics
+REROUTE_LOG_LEN = 256
 
 
 def client_pool_size(num_indexes: int) -> int:
@@ -116,11 +136,34 @@ class MultiRankError(RuntimeError):
         return [o["result"] for o in self.outcomes if o["ok"]]
 
 
+class QuorumError(RuntimeError):
+    """A replicated write reached SOME replicas but not the configured
+    quorum. The batch is NOT acknowledged (callers must treat it as
+    unplaced and may retry — the at-least-once duplicate caveat of the
+    write path applies), but the partial placement is recorded in the
+    repair queue so a later repair pass can complete the group instead
+    of stranding the rows on a minority replica."""
+
+    def __init__(self, index_id: str, group: int, acked: List[int],
+                 needed: int, failures: List[dict]):
+        self.index_id = index_id
+        self.group = group
+        self.acked = list(acked)
+        self.needed = needed
+        self.failures = list(failures)
+        super().__init__(
+            f"write quorum missed for {index_id!r} group {group}: "
+            f"{len(self.acked)}/{needed} acks "
+            f"(failed replicas: {[f['skipped_server'] for f in failures]})"
+        )
+
+
 class IndexClient:
     """Handle to a cluster of index servers (one shard each)."""
 
     def __init__(self, server_list_path: str, cfg_path: Optional[str] = None,
-                 retry_policy: Optional[rpc.RetryPolicy] = None):
+                 retry_policy: Optional[rpc.RetryPolicy] = None,
+                 replication_cfg: Optional[ReplicationCfg] = None):
         machine_ports = IndexClient.read_server_list(server_list_path)
         self.sub_indexes = IndexClient.setup_connection(machine_ports)
         self.num_indexes = len(self.sub_indexes)
@@ -145,9 +188,30 @@ class IndexClient:
         # client)
         self._rng = random.Random()
         self.retry = retry_policy if retry_policy is not None else rpc.RetryPolicy()
-        # one entry per batch that had to skip a dead rank:
-        # {index_id, skipped_server, host, port, error, rerouted_to}
-        self.reroutes: List[dict] = []
+        # bounded ring of recent dead-rank skips — one entry per (batch,
+        # skipped replica): {index_id, skipped_server, host, port, error,
+        # rerouted_to}. Monotonic totals live in ``counters`` (the ring
+        # caps memory on a long-lived client; see get_perf_stats).
+        self._stats_lock = lockdep.lock("IndexClient._stats_lock")
+        self.reroutes = deque(maxlen=REROUTE_LOG_LEN)
+        self.counters = {"reroutes": 0, "failovers": 0,
+                         "under_replicated": 0, "quorum_failures": 0}
+        # replica-group membership: logical shard group -> stub positions
+        # (R=1 degenerates to one group per rank — the pre-replication
+        # topology). Built from each rank's registered shard_group with a
+        # discovery-order striping fallback, then pushed back so every
+        # rank knows its group (the registration op).
+        self.rcfg = (replication_cfg if replication_cfg is not None
+                     else ReplicationCfg.from_env())
+        eff_r = min(self.rcfg.replication, max(self.num_indexes, 1))
+        self.quorum = replication.quorum_size(
+            eff_r, min(self.rcfg.write_quorum, eff_r))
+        self.repair_queue = replication.RepairQueue(self.rcfg.repair_queue_len)
+        # group -> pinned replica position for the read path (updated by
+        # failover); guarded by _stats_lock like the other fan-out state
+        self._preferred = {}
+        self.membership = self._build_membership()
+        self._register_groups()
         self.cfg = IndexCfg.from_json(cfg_path) if cfg_path is not None else None
 
     # ------------------------------------------------------------ discovery
@@ -164,11 +228,21 @@ class IndexClient:
         (reference client.py:87-120). A not-yet-created (or still-empty)
         file counts as "0 of N registered" and keeps waiting — the launcher
         writes the header AFTER a client may have started — instead of
-        raising FileNotFoundError before the backoff loop even begins."""
+        raising FileNotFoundError before the backoff loop even begins.
+
+        Duplicate ``host,port`` lines DEDUPE (first occurrence keeps its
+        position, so stub order stays registration order): a RESTARTED
+        rank that re-appends its discovery line used to push ``len(res)``
+        past ``num_servers`` forever, wedging every new client in this
+        loop until the 7200 s timeout. For the same reason the count
+        check accepts ``len(res) >= num_servers`` — extra distinct
+        entries (a rank that moved ports mid-life) connect rather than
+        hang, with a warning."""
         time_waited = 0.0
         while True:
             num_servers = None
             res = []
+            seen = set()
             try:
                 with open(server_list_path) as f:
                     for idx, line in enumerate(f):
@@ -179,11 +253,20 @@ class IndexClient:
                             num_servers = int(line)
                         else:
                             host, port = line.split(",")[:2]
-                            res.append((host.strip(), int(port)))
+                            entry = (host.strip(), int(port))
+                            if entry in seen:
+                                continue  # re-registered (restarted) rank
+                            seen.add(entry)
+                            res.append(entry)
             except FileNotFoundError:
                 msg = f"server list {server_list_path} not created yet."
             else:
-                if num_servers is not None and num_servers == len(res):
+                if num_servers is not None and len(res) >= num_servers:
+                    if len(res) > num_servers:
+                        logger.warning(
+                            "server list %s advertises %d servers but has "
+                            "%d distinct entries; connecting to all of them",
+                            server_list_path, num_servers, len(res))
                     return res
                 if num_servers is None:
                     msg = f"server list {server_list_path} is empty."
@@ -206,6 +289,89 @@ class IndexClient:
         return [
             rpc.Client(i, host, port) for i, (host, port) in enumerate(machine_ports)
         ]
+
+    # ------------------------------------------------------- replica membership
+
+    def _build_membership(self) -> replication.MembershipTable:
+        """Group map from each rank's registered shard_group, falling back
+        to discovery-order striping (replication.assign_groups) for ranks
+        that report none (legacy server, fresh restart) or are
+        unreachable at construction."""
+        derived = replication.assign_groups(
+            self.num_indexes, self.rcfg.replication)
+
+        def one(pair):
+            pos, stub = pair
+            try:
+                gid = self._call_with_retry(stub, "get_shard_group")
+            except Exception:
+                gid = None  # legacy server or dead rank: derived striping
+            return derived[pos] if gid is None else int(gid)
+
+        groups = list(self.pool.map(one, enumerate(self.sub_indexes)))
+        return replication.MembershipTable(groups)
+
+    def _register_groups(self) -> None:
+        """Push each rank's group assignment (the registration op) —
+        best-effort: a dead or legacy rank just keeps the client-side
+        derived assignment until it rejoins."""
+
+        def one(pair):
+            pos, stub = pair
+            gid = self.membership.group_of(pos)
+            try:
+                self._call_with_retry(stub, "set_shard_group", (gid,))
+            except Exception as e:
+                logger.debug("shard_group registration skipped for rank "
+                             "%s: %s", stub.id, e)
+
+        list(self.pool.map(one, enumerate(self.sub_indexes)))
+
+    def mark_rank_left(self, pos: int) -> None:
+        """Take a stub position out of read/write rotation (planned
+        decommission). Reads stop routing to it immediately; its group
+        keeps serving from the remaining replicas."""
+        self.membership.remove(pos)
+        with self._stats_lock:
+            self._preferred = {g: p for g, p in self._preferred.items()
+                               if p != pos}
+
+    def resync_rank(self, index_id: str, pos: int,
+                    source_pos: Optional[int] = None) -> dict:
+        """Online (re)join: have the rank at stub position ``pos`` stream
+        the shard from a live replica of its group (MANIFEST-committed
+        generation + buffer delta, server.sync_shard_from), then
+        re-register it into the group — no client restart, no downtime
+        for the surviving replicas. ``source_pos`` pins the seed replica;
+        by default every other replica of the group is tried in order."""
+        group = self.membership.group_of(pos)
+        if group is None:
+            raise RuntimeError(f"stub position {pos} is in no replica group")
+        if source_pos is not None:
+            candidates = [source_pos]
+        else:
+            candidates = [p for p in self.membership.replicas(group)
+                          if p != pos]
+        if not candidates:
+            raise RuntimeError(
+                f"group {group} has no live replica to seed rank {pos} from")
+        last_exc = None
+        for src in candidates:
+            src_stub = self.sub_indexes[src]
+            try:
+                out = self._call_with_retry(
+                    self.sub_indexes[pos], "sync_shard_from",
+                    (index_id, src_stub.host, src_stub.port, group))
+            except Exception as e:
+                last_exc = e
+                logger.warning("resync of rank %s from replica %s failed: "
+                               "%s", pos, src, e)
+                continue
+            self.membership.register(pos, group)
+            return out
+        raise RuntimeError(
+            f"no replica of group {group} could seed rank {pos}"
+        ) from last_exc
 
     # ------------------------------------------------------- fault-tolerant fan-out
 
@@ -310,39 +476,142 @@ class IndexClient:
         request) was lost can duplicate rows — unique metadata ids make
         that detectable downstream.
         """
+        groups = sorted(self.membership.snapshot().items())
+        if not groups:
+            raise RuntimeError("no replica groups registered")
         if index_id not in self.cur_server_ids:
-            self.cur_server_ids[index_id] = self._rng.randint(0, self.num_indexes - 1)
-        sid = self.cur_server_ids[index_id]
+            self.cur_server_ids[index_id] = self._rng.randint(0, len(groups) - 1)
+        start = self.cur_server_ids[index_id] % len(groups)
         last_exc = None
-        for offset in range(self.num_indexes):
-            target = (sid + offset) % self.num_indexes
-            stub = self.sub_indexes[target]
-            try:
-                self._call_with_retry(
-                    stub, "add_index_data",
-                    (index_id, embeddings, metadata, train_async_if_triggered),
-                )
-            except rpc.TRANSPORT_ERRORS as e:
-                logger.warning(
-                    "add_index_data: rank %s (%s:%s) unreachable after "
-                    "retries, rerouting batch to next rank: %s",
-                    stub.id, stub.host, stub.port, e,
-                )
-                self.reroutes.append({
-                    "index_id": index_id,
-                    "skipped_server": stub.id,
-                    "host": stub.host,
-                    "port": stub.port,
-                    "error": f"{type(e).__name__}: {e}",
-                    "rerouted_to": (target + 1) % self.num_indexes,
-                })
-                last_exc = e
-                continue
-            self.cur_server_ids[index_id] = (target + 1) % self.num_indexes
-            return
+        for offset in range(len(groups)):
+            gi = (start + offset) % len(groups)
+            gid, reps = groups[gi]
+            next_reps = groups[(gi + 1) % len(groups)][1]
+            # effective quorum clamps to the group's REGISTERED size: a
+            # group shrunk by mark_rank_left (planned decommission) must
+            # keep acking on the replicas it still has — demanding acks
+            # from replicas that no longer exist would fail every write
+            # to that shard forever
+            needed = min(self.quorum, len(reps))
+            acked, failed = self._write_group(
+                index_id, reps, embeddings, metadata, train_async_if_triggered)
+            if len(acked) >= needed:
+                if failed:
+                    # acked at quorum but not everywhere: the batch is
+                    # durable; the missing replicas go to repair
+                    self._record_under_replicated(
+                        index_id, gid, failed, embeddings, metadata)
+                self.cur_server_ids[index_id] = (gi + 1) % len(groups)
+                return
+            if acked:
+                # partial placement below quorum: NOT acknowledged, and
+                # rerouting to another group would duplicate the rows a
+                # minority replica already holds across shards — record
+                # for repair and raise instead
+                records = self._record_under_replicated(
+                    index_id, gid, failed, embeddings, metadata)
+                with self._stats_lock:
+                    self.counters["quorum_failures"] += 1
+                raise QuorumError(index_id, gid, acked, needed, records)
+            # the whole group is transport-dead: reroute the batch to the
+            # next group (PR 3 semantics, generalized from ranks to groups)
+            with self._stats_lock:
+                for pos, e in failed:
+                    stub = self.sub_indexes[pos]
+                    logger.warning(
+                        "add_index_data: rank %s (%s:%s) unreachable after "
+                        "retries, rerouting batch to next group: %s",
+                        stub.id, stub.host, stub.port, e,
+                    )
+                    self.reroutes.append({
+                        "index_id": index_id,
+                        "skipped_server": stub.id,
+                        "host": stub.host,
+                        "port": stub.port,
+                        "error": f"{type(e).__name__}: {e}",
+                        "rerouted_to": next_reps[0] if next_reps else None,
+                    })
+                    self.counters["reroutes"] += 1
+                    last_exc = e
         raise RuntimeError(
             f"add_index_data for {index_id!r} failed on every rank"
         ) from last_exc
+
+    def _write_group(self, index_id: str, reps: List[int],
+                     embeddings: np.ndarray, metadata,
+                     train_async_if_triggered: bool):
+        """Fan one batch out to every replica of a group. Returns
+        ``(acked positions, [(position, transport error), ...])``; an
+        application error from a live replica (ServerException: index not
+        created, bad args) propagates immediately — it would repeat
+        identically on every replica."""
+
+        def one(pos):
+            try:
+                self._call_with_retry(
+                    self.sub_indexes[pos], "add_index_data",
+                    (index_id, embeddings, metadata, train_async_if_triggered),
+                )
+                return (pos, None)
+            except rpc.TRANSPORT_ERRORS as e:
+                return (pos, e)
+
+        results = list(self.pool.map(one, reps))
+        acked = [p for p, e in results if e is None]
+        failed = [(p, e) for p, e in results if e is not None]
+        return acked, failed
+
+    def _record_under_replicated(self, index_id: str, gid: int, failed,
+                                 embeddings, metadata) -> List[dict]:
+        """Log replicas that missed a write into the bounded repair queue
+        (one record per batch, carrying the payload for the re-send)."""
+        records = [{
+            "skipped_server": self.sub_indexes[pos].id,
+            "host": self.sub_indexes[pos].host,
+            "port": self.sub_indexes[pos].port,
+            "error": f"{type(e).__name__}: {e}",
+        } for pos, e in failed]
+        self.repair_queue.record({
+            "index_id": index_id,
+            "group": gid,
+            "missing": [pos for pos, _e in failed],
+            "embeddings": embeddings,
+            "metadata": metadata,
+            "failures": records,
+        })
+        with self._stats_lock:
+            self.counters["under_replicated"] += 1
+        return records
+
+    def repair_under_replicated(self) -> dict:
+        """Background repair: re-send every recorded under-replicated
+        batch to the replicas that missed it. Batches whose replicas are
+        still unreachable go back on the (bounded) queue. Returns
+        ``{"repaired": n, "still_pending": m}``. Idempotence rides the
+        write path's at-least-once contract: unique metadata ids make a
+        double-applied repair detectable downstream."""
+        repaired = still_pending = 0
+        for item in self.repair_queue.drain():
+            missing = []
+            for pos in item["missing"]:
+                try:
+                    self._call_with_retry(
+                        self.sub_indexes[pos], "add_index_data",
+                        (item["index_id"], item["embeddings"],
+                         item["metadata"], True))
+                except Exception as e:
+                    logger.warning("repair of %s group %s on rank %s still "
+                                   "failing: %s", item["index_id"],
+                                   item["group"], pos, e)
+                    missing.append(pos)
+            if missing:
+                item["missing"] = missing
+                self.repair_queue.record(item)
+                still_pending += 1
+            else:
+                self.repair_queue.mark_repaired()
+                repaired += 1
+        return {"repaired": repaired, "still_pending": still_pending}
 
     def sync_train(self, index_id: str) -> None:
         self._broadcast("sync_train", (index_id,))
@@ -368,6 +637,14 @@ class IndexClient:
         deadline: Optional[float] = None,
     ) -> tuple:  # (D, meta[, embs][, missing]) — see docstring
         """Fan-out search with client-side top-k merge.
+
+        With replication (R > 1) the fan-out targets ONE live replica per
+        logical shard group; a transport-dead replica fails over to the
+        next replica of its group transparently (and pins it for
+        subsequent calls), so results stay complete — and identical —
+        through a single rank death. ``missing``/raise semantics below
+        then apply per GROUP (a shard degrades only when every replica
+        is gone), which with R=1 is exactly the per-rank behavior.
 
         allow_partial=False (default, reference behavior): any dead rank
         raises. allow_partial=True completes the hook the reference stubbed
@@ -407,55 +684,100 @@ class IndexClient:
             )
         abs_deadline = None if deadline is None else time.time() + deadline
         maximize_metric = self.cfg.metric == "dot"
-        if not allow_partial:
-            # BUSY (and only BUSY) retries here: transport errors keep the
-            # reference's fail-fast contract in strict mode, while an
-            # overloaded rank gets the RetryPolicy's jittered backoff
-            results = self.pool.map(
-                lambda idx: self.retry.run_filtered(
-                    (rpc.BusyError,), abs_deadline, idx.generic_fun,
-                    "search", (index_id, query, topk, return_embeddings),
-                    None, deadline=abs_deadline,
-                ),
-                self.sub_indexes,
+        # one call per replica GROUP (exactly one block per logical shard
+        # reaches the merge — a replica never double-counts); the plan's
+        # per-group ordering is the failover walk, led by the pinned
+        # replica from the last successful call
+        with self._stats_lock:
+            preferred = dict(self._preferred)
+        plan = replication.plan_read_fanout(self.membership, preferred)
+        if not plan:
+            raise RuntimeError("no replica groups registered")
+
+        def call_stub(idx, timeout=None):
+            # BUSY (and only BUSY) retries in place: transport errors keep
+            # their degrade-fast semantics (failover to the next replica,
+            # or the strict/partial contract below), while an overloaded
+            # rank gets the RetryPolicy's jittered backoff
+            return self.retry.run_filtered(
+                (rpc.BusyError,), abs_deadline, idx.generic_fun,
+                "search", (index_id, query, topk, return_embeddings),
+                None, timeout=timeout, deadline=abs_deadline,
             )
+
+        def note_failover(group, pos):
+            with self._stats_lock:
+                self.counters["failovers"] += 1
+                self._preferred[group] = pos
+
+        if not allow_partial:
+            # strict mode: a group with NO serving replica raises (the
+            # reference's fail-fast contract, per logical shard). With
+            # R=1 (one replica per group) this is byte-for-byte the old
+            # all-ranks fan-out: the first transport error propagates.
+            def one_strict(item):
+                group, _pick, ordering = item
+                last = None
+                for i, pos in enumerate(ordering):
+                    idx = self.sub_indexes[pos]
+                    try:
+                        out = call_stub(idx)
+                    except rpc.TRANSPORT_ERRORS + (rpc.BusyError,) as e:
+                        logger.warning(
+                            "replica %s (%s:%s) of group %s failed during "
+                            "search, failing over: %s",
+                            idx.id, idx.host, idx.port, group, e)
+                        last = e
+                        continue
+                    if i > 0:
+                        note_failover(group, pos)
+                    return out
+                raise last
+
+            results = self.pool.map(one_strict, plan)
             return IndexClient._aggregate_results(
                 results, topk, q_size, maximize_metric, return_embeddings
             )
 
-        def one(idx):
-            try:
-                return self.retry.run_filtered(
-                    (rpc.BusyError,), abs_deadline, idx.generic_fun,
-                    "search", (index_id, query, topk, return_embeddings),
-                    None, timeout=partial_timeout, deadline=abs_deadline,
-                )
-            # TRANSPORT failures only (dead/unreachable/hung rank — OSError
-            # covers refused/reset/broken-pipe/socket-timeout; EOFError a
-            # mid-frame stream end), plus a rank still BUSY after the retry
-            # budget or one that shed this rank's request past its deadline
-            # (alive but overloaded — partial mode's contract is best-effort
-            # results from whoever can serve in time; healthy ranks that
-            # answered in-budget must not be discarded because one shard
-            # couldn't). A ServerException means the rank is alive and
-            # rejected the request (index not loaded, not trained, bad
-            # args): masking it as "missing" would silently drop a healthy
-            # shard's corpus from every result, so it propagates in partial
-            # mode too.
-            except (OSError, EOFError, rpc.BusyError,
-                    rpc.DeadlineExceeded) as e:
-                logger.warning(
-                    "rank %s (%s:%s) unreachable during search; serving "
-                    "partial results: %s", idx.id, idx.host, idx.port, e,
-                )
-                return _FailedRank(idx, e)
+        # partial mode: a group whose EVERY replica is transport-dead (or
+        # still BUSY after the retry budget / past its deadline — alive
+        # but unable to serve in time) degrades into the trailing
+        # ``missing`` list, one entry per failed replica tried. An
+        # application error from a live replica (ServerException: index
+        # not loaded, not trained, bad args) still raises — masking it
+        # would silently drop a healthy shard's corpus. OSError covers
+        # refused/reset/broken-pipe/socket-timeout, EOFError a mid-frame
+        # stream end, FrameError/UnpicklingError a garbled response.
+        def one_partial(item):
+            group, _pick, ordering = item
+            fails = []
+            for i, pos in enumerate(ordering):
+                idx = self.sub_indexes[pos]
+                try:
+                    out = call_stub(idx, timeout=partial_timeout)
+                except rpc.DeadlineExceeded as e:
+                    # the call's budget is spent: another replica cannot
+                    # answer any sooner, so the group degrades now
+                    fails.append(_FailedRank(idx, e))
+                    break
+                except rpc.TRANSPORT_ERRORS + (rpc.BusyError,) as e:
+                    logger.warning(
+                        "replica %s (%s:%s) of group %s unreachable during "
+                        "search; trying next replica: %s",
+                        idx.id, idx.host, idx.port, group, e)
+                    fails.append(_FailedRank(idx, e))
+                    continue
+                if i > 0:
+                    note_failover(group, pos)
+                return out
+            return fails
 
-        raw = list(self.pool.map(one, self.sub_indexes))
-        ok = [r for r in raw if not isinstance(r, _FailedRank)]
+        raw = list(self.pool.map(one_partial, plan))
+        ok = [r for r in raw if not isinstance(r, list)]
         missing = [
-            {"server": r.stub.id, "host": r.stub.host, "port": r.stub.port,
-             "error": f"{type(r.error).__name__}: {r.error}"}
-            for r in raw if isinstance(r, _FailedRank)
+            {"server": f.stub.id, "host": f.stub.host, "port": f.stub.port,
+             "error": f"{type(f.error).__name__}: {f.error}"}
+            for fails in raw if isinstance(fails, list) for f in fails
         ]
         if not ok:
             raise RuntimeError(
@@ -578,10 +900,32 @@ class IndexClient:
         return IndexState.get_aggregated_states(states)
 
     def get_ntotal(self, index_id: str) -> int:
-        return sum(self.pool.map(
-            lambda idx: self._call_with_retry(idx, "get_ntotal", (index_id,)),
-            self.sub_indexes,
-        ))
+        """Logical row count: per replica GROUP the max over its LIVE
+        replicas (replicas converge but may briefly differ mid-repair),
+        summed across groups — a replicated row counts once, and like
+        the read path a dead replica degrades to its group peers instead
+        of failing the whole call. Raises (the transport error) only
+        when a group has no reachable replica — which with R=1 is
+        exactly the old all-ranks-sum behavior."""
+        snapshot = sorted(self.membership.snapshot().items())
+        positions = [p for _g, reps in snapshot for p in reps]
+
+        def one(pos):
+            try:
+                return self._call_with_retry(
+                    self.sub_indexes[pos], "get_ntotal", (index_id,))
+            except rpc.TRANSPORT_ERRORS as e:
+                return e
+
+        counts = dict(zip(positions, self.pool.map(one, positions)))
+        total = 0
+        for _g, reps in snapshot:
+            live = [counts[p] for p in reps
+                    if not isinstance(counts[p], BaseException)]
+            if not live:
+                raise next(counts[p] for p in reps)
+            total += max(live)
+        return total
 
     def get_buffer_depth(self, index_id: str) -> int:
         """Cluster-wide count of buffered-but-unindexed vectors (sums the
@@ -619,15 +963,42 @@ class IndexClient:
         CLIENT-side view of that rank's stub — instantaneous/peak
         pipelining depth and wire round-trip percentiles — so operators
         see mux depth and wire p99 next to the rank's own scheduler and
-        engine stats (docs/OPERATIONS.md#wire-protocol-appendix)."""
+        engine stats (docs/OPERATIONS.md#wire-protocol-appendix).
+
+        Replication observability (ISSUE 8 satellite): each entry's
+        ``"replication"`` key (the server's {rank, shard_group} identity)
+        gains a ``"client"`` sub-dict with this client's fan-out
+        counters — monotonic reroute/failover/under-replicated/
+        quorum-failure totals, the bounded recent-reroute ring's length,
+        and the repair queue's recorded/repaired/dropped/pending state —
+        mirroring how ``rpc.client`` carries the stub-side mux view."""
         stats = list(self.pool.map(
             lambda idx: self._call_with_retry(idx, "get_perf_stats"),
             self.sub_indexes,
         ))
+        repl = self.get_replication_stats()
         for stub, entry in zip(self.sub_indexes, stats):
             if isinstance(entry, dict) and hasattr(stub, "rpc_stats"):
                 entry.setdefault("rpc", {})["client"] = stub.rpc_stats()
+            if isinstance(entry, dict):
+                entry.setdefault("replication", {})["client"] = repl
         return stats
+
+    def get_replication_stats(self) -> dict:
+        """Client-side replication counters: monotonic totals, the recent
+        reroute ring size, membership, and repair-queue state."""
+        with self._stats_lock:
+            counters = dict(self.counters)
+            recent = len(self.reroutes)
+        return {
+            "counters": counters,
+            "recent_reroutes": recent,
+            "quorum": self.quorum,
+            "replication": self.rcfg.replication,
+            "groups": {g: list(ps)
+                       for g, ps in self.membership.snapshot().items()},
+            "repair": self.repair_queue.stats(),
+        }
 
     def ping(self, timeout: float = 10.0) -> list:
         """Health-check every server; returns per-server dicts or the error

@@ -1,0 +1,224 @@
+"""Shard replication: membership table, quorum math, and repair queue.
+
+The reference system (and this repo through PR 7) is shared-nothing with
+exactly ONE owner per shard: PR 3's retry/reroute keeps *ingest* alive
+through a rank death, but the dead rank's rows silently vanish from every
+*search* until an operator restarts it. This module is the membership
+layer that removes that single point of failure:
+
+- ``assign_groups`` / ``build_membership`` map the discovery-file rank
+  order onto logical shard GROUPS of replication factor R (modular
+  striping: with N ranks and G = N // R groups, stub position p serves
+  group ``p % G`` — so killing any one rank leaves every group with a
+  live replica as long as R >= 2). A rank that registered an explicit
+  group (the ``shard_group`` registration op, env ``DFT_SHARD_GROUP``
+  server-side) overrides the derived assignment, which is how a rejoined
+  or migrated rank re-enters its group online.
+- ``MembershipTable`` is the thread-safe group -> replica-positions map
+  the client consults per call. Reads snapshot under the table lock and
+  fan-out happens OUTSIDE it (never an RPC under the membership lock —
+  lock-order/blocking checkers and the DFT_LOCKDEP witness cover it).
+- ``quorum_size`` is the write-ack contract: explicit ``write_quorum``
+  if configured, else majority (R // 2 + 1). An ``add_index_data`` batch
+  acks when >= quorum replicas acked; replicas that missed the write are
+  recorded in the ``RepairQueue`` for background re-send
+  (``IndexClient.repair_under_replicated``).
+- ``RepairQueue`` is a bounded deque of under-replicated batch records
+  plus monotonic counters — a long-lived client must not grow state
+  without bound (the same rationale as capping ``IndexClient.reroutes``).
+
+Config rides ``utils.config.ReplicationCfg`` (``DFT_REPLICATION``,
+``DFT_WRITE_QUORUM``); R=1 (the default) degenerates to the pre-PR-8
+one-owner-per-shard behavior exactly: one group per rank, quorum 1.
+"""
+
+import logging
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from distributed_faiss_tpu.utils import lockdep
+
+logger = logging.getLogger()
+
+
+def quorum_size(replication: int, write_quorum: int = 0) -> int:
+    """Acks required before a replicated write reports success.
+
+    ``write_quorum`` == 0 (the default) means MAJORITY: R // 2 + 1 —
+    1 for R=1, 2 for R=2 and R=3, 3 for R=4... An explicit value is
+    clamped into [1, R] at config validation; asking for R means
+    every replica must ack (no under-replicated acks, writes stall on
+    any dead rank), asking for 1 means any single replica suffices
+    (maximum availability, repair carries the rest).
+    """
+    if replication < 1:
+        raise ValueError("replication factor must be >= 1")
+    if write_quorum:
+        if not 1 <= write_quorum <= replication:
+            raise ValueError(
+                f"write_quorum {write_quorum} outside [1, {replication}]")
+        return write_quorum
+    return replication // 2 + 1
+
+
+def assign_groups(num_ranks: int, replication: int) -> List[int]:
+    """Derived group id per stub position (discovery-file order).
+
+    Modular striping: G = num_ranks // replication groups (>= 1), stub
+    position p -> group ``p % G``. Every group gets at least
+    ``replication`` replicas; when R does not divide N the remainder
+    ranks land as extra replicas of the low groups instead of forming an
+    under-replicated tail group.
+    """
+    if replication < 1:
+        raise ValueError("replication factor must be >= 1")
+    if num_ranks < 1:
+        return []
+    if replication > num_ranks:
+        logger.warning(
+            "replication factor %d > %d ranks: clamping to %d",
+            replication, num_ranks, num_ranks)
+        replication = num_ranks
+    num_groups = max(1, num_ranks // replication)
+    return [p % num_groups for p in range(num_ranks)]
+
+
+class MembershipTable:
+    """Thread-safe logical-shard -> replica-positions map.
+
+    Positions are stub indexes into ``IndexClient.sub_indexes`` (i.e.
+    discovery-file order), NOT server ranks: the client's whole fan-out
+    machinery addresses stubs. ``register`` moves a position between
+    groups online (rank join/rejoin); ``remove`` takes a position out of
+    rotation (rank leave/decommission). Replica order within a group is
+    stable registration order — the read path's failover ordering.
+    """
+
+    def __init__(self, groups_by_pos: List[int]):
+        self._lock = lockdep.lock("MembershipTable._lock")
+        self._group_of: Dict[int, int] = {}
+        self._groups: Dict[int, List[int]] = {}
+        for pos, gid in enumerate(groups_by_pos):
+            self._groups.setdefault(int(gid), []).append(pos)
+            self._group_of[pos] = int(gid)
+
+    def groups(self) -> List[int]:
+        with self._lock:
+            return sorted(self._groups)
+
+    def replicas(self, group: int) -> List[int]:
+        """Stable replica ordering for one group (copy, safe to mutate)."""
+        with self._lock:
+            return list(self._groups.get(group, ()))
+
+    def group_of(self, pos: int) -> Optional[int]:
+        with self._lock:
+            return self._group_of.get(pos)
+
+    def register(self, pos: int, group: int) -> None:
+        """(Re-)register a stub position into a group — the online-join
+        hook: a rank that finished its MANIFEST transfer registers here
+        and the next fan-out includes it."""
+        group = int(group)
+        with self._lock:
+            old = self._group_of.get(pos)
+            if old == group:
+                return
+            if old is not None and pos in self._groups.get(old, ()):
+                self._groups[old].remove(pos)
+                if not self._groups[old]:
+                    del self._groups[old]
+            self._groups.setdefault(group, []).append(pos)
+            self._group_of[pos] = group
+
+    def remove(self, pos: int) -> None:
+        """Take a position out of rotation (rank leave)."""
+        with self._lock:
+            old = self._group_of.pop(pos, None)
+            if old is not None and pos in self._groups.get(old, ()):
+                self._groups[old].remove(pos)
+                if not self._groups[old]:
+                    del self._groups[old]
+
+    def snapshot(self) -> Dict[int, List[int]]:
+        """{group: [positions]} copy — fan-out planning happens on this,
+        outside the table lock."""
+        with self._lock:
+            return {g: list(ps) for g, ps in self._groups.items()}
+
+    def __repr__(self) -> str:
+        return f"<MembershipTable {self.snapshot()}>"
+
+
+def plan_read_fanout(
+    membership: MembershipTable,
+    preferred: Dict[int, int],
+) -> List[Tuple[int, int, List[int]]]:
+    """One (group, chosen position, failover ordering) triple per group.
+
+    ``preferred`` maps group -> the position pinned by the last
+    successful call (or failover); a pinned position that left the group
+    falls back to the group's first replica. The failover ordering is
+    the group's replica list rotated so the chosen position leads — the
+    caller walks it left to right on transport errors. Exactly one call
+    per group reaches the merge (groups partition the positions), which
+    is what keeps R identical replicas of a shard from ever
+    double-counting their rows in the client-side heap merge.
+    """
+    plan: List[Tuple[int, int, List[int]]] = []
+    for group, reps in sorted(membership.snapshot().items()):
+        if not reps:
+            continue
+        pin = preferred.get(group)
+        start = reps.index(pin) if pin in reps else 0
+        ordering = reps[start:] + reps[:start]
+        plan.append((group, ordering[0], ordering))
+    return plan
+
+
+class RepairQueue:
+    """Bounded record of under-replicated writes awaiting background
+    repair.
+
+    Each entry carries everything a re-send needs — the batch itself
+    (embeddings + metadata) plus the replica positions that missed it.
+    Bounded: beyond ``maxlen`` entries the OLDEST record (and its batch
+    payload) is dropped and the ``dropped`` counter bumps — a long-lived
+    client trades repair completeness for bounded memory, and the
+    counter makes the trade visible in ``get_perf_stats``. Counters are
+    monotonic: ``recorded``, ``repaired``, ``dropped``.
+    """
+
+    def __init__(self, maxlen: int = 256):
+        self._lock = lockdep.lock("RepairQueue._lock")
+        self._items = deque(maxlen=max(1, int(maxlen)))
+        self._counters = {"recorded": 0, "repaired": 0, "dropped": 0}
+
+    def record(self, entry: dict) -> None:
+        with self._lock:
+            if len(self._items) == self._items.maxlen:
+                self._counters["dropped"] += 1
+            self._items.append(entry)
+            self._counters["recorded"] += 1
+
+    def drain(self) -> List[dict]:
+        """Pop every pending record (the repair pass owns them; records
+        that still fail must be re-``record``-ed by the caller)."""
+        with self._lock:
+            items, n = list(self._items), len(self._items)
+            self._items.clear()
+        return items
+
+    def mark_repaired(self, n: int = 1) -> None:
+        with self._lock:
+            self._counters["repaired"] += n
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out["pending"] = len(self._items)
+        return out

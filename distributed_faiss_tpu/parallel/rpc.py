@@ -135,6 +135,17 @@ KIND_BUSY = 4
 KIND_RESULT_MUX = 5
 KIND_ERROR_MUX = 6
 KIND_BUSY_MUX = 7
+# shard transfer (replication membership, parallel/replication.py): a
+# joining/rejoining rank fetches a live replica's shard as one atomic
+# snapshot. FETCH carries ``(index_id,)`` client -> server; the server
+# answers with SHARD_DATA whose payload is the engine's export_snapshot
+# dict (index state_dict + metadata + buffer delta — ndarrays ride the
+# raw-buffer tensor path like any frame). These frames travel on a
+# DEDICATED connection (Client.fetch_shard dials its own socket): bulk
+# shard bytes must never head-of-line-block a serving connection's mux
+# window, and the demux reader therefore never sees them.
+KIND_SHARD_FETCH = 8
+KIND_SHARD_DATA = 9
 
 # untagged kind -> its tagged variant (and back), for servers writing
 # req_id-tagged responses and the client-side demux unwrapping them
@@ -764,8 +775,34 @@ class Client:
         self.stats.record("round_trip_s", time.perf_counter() - t0)
         return self._interpret(kind, payload, fname)
 
+    def fetch_shard(self, index_id: str, timeout: float = 120.0):
+        """Fetch a replica's shard snapshot over a DEDICATED connection
+        (shard transfer is bulk — megabytes of index state — and must not
+        head-of-line-block this stub's serving connection or confuse the
+        demux reader, so it never touches ``self.sock``). Sends
+        KIND_SHARD_FETCH, returns the KIND_SHARD_DATA payload (the
+        source engine's export_snapshot dict); server-side failures come
+        back as ordinary KIND_ERROR frames and raise ServerException.
+        The socket deadline bounds the whole exchange."""
+        sock = socket.socket(self._fam, socket.SOCK_STREAM)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(timeout)
+        try:
+            sock.connect((self.host, self.port))
+            send_frame(sock, KIND_SHARD_FETCH, (index_id,))
+            kind, payload = recv_frame(sock)
+            try:
+                send_frame(sock, KIND_CLOSE, None)
+            except OSError:
+                pass  # courtesy frame only; the snapshot already landed
+        finally:
+            sock.close()
+        return self._interpret(kind, payload, "fetch_shard")
+
     def _interpret(self, kind, payload, fname):
         if kind == KIND_RESULT:
+            return payload
+        if kind == KIND_SHARD_DATA:
             return payload
         if kind == KIND_ERROR:
             raise ServerException(payload)

@@ -88,12 +88,21 @@ class IndexServer:
         # bounded queue + batcher thread (serving/scheduler.py); every other
         # op keeps the direct dispatch path. DFT_SCHEDULER=0 (or an explicit
         # cfg with enabled=False) restores pre-scheduler direct serving.
+        # replica-group membership (parallel/replication.py): which logical
+        # shard group this rank serves. None until registered — the client
+        # derives a default from discovery order and pushes it via the
+        # set_shard_group op; DFT_SHARD_GROUP pins it at launch (a rank
+        # rejoining a known group after restart).
+        raw_group = os.environ.get("DFT_SHARD_GROUP")
+        self.shard_group: Optional[int] = (
+            int(raw_group) if raw_group not in (None, "") else None)
         cfg = scheduler_cfg if scheduler_cfg is not None else SchedulerCfg.from_env()
         self.scheduler: Optional[SearchScheduler] = None
         if cfg.enabled:
             self.scheduler = SearchScheduler(
                 self._engine_search_batched, cfg,
-                name=f"search-batcher:r{rank}")
+                name=f"search-batcher:r{rank}",
+                tag={"rank": rank, "shard_group": self.shard_group})
         # request multiplexing: calls whose frame meta carries a req_id are
         # dispatched without blocking the connection's reader (search → the
         # scheduler's async completion path, everything else → this worker
@@ -164,6 +173,7 @@ class IndexServer:
                 x for x in self._train_threads
                 if x.ident is None or x.is_alive()]
             self._train_threads.append(t)
+            # graftlint: ok(blocking-under-lock): Thread.start() is not IndexServer.start (name-based launch propagation); starting inside the lock is load-bearing — a concurrent stop() must never snapshot (and join) a not-yet-started thread
             t.start()
 
     def get_state(self, index_id: str) -> IndexState:
@@ -208,7 +218,11 @@ class IndexServer:
 
     def drop_index(self, index_id: str) -> None:
         with self.indexes_lock:
-            self.indexes.pop(index_id, None)
+            old = self.indexes.pop(index_id, None)
+        if old is not None:
+            # stop the dropped engine's save watcher: a late autosave
+            # would resurrect the index on disk after the drop
+            old.retire()
 
     def get_ids(self, index_id: str = "default") -> set:
         return self._get_index(index_id).get_ids()
@@ -224,6 +238,61 @@ class IndexServer:
 
     def get_rank(self) -> int:
         return self.rank
+
+    # ------------------------------------------------------- replica membership
+
+    def get_shard_group(self) -> Optional[int]:
+        """Logical shard group this rank serves (None = unregistered)."""
+        return self.shard_group
+
+    def set_shard_group(self, group: Optional[int]) -> Optional[int]:
+        """The per-rank registration op: the client (or an operator)
+        assigns this rank's replica group. Tagged into the scheduler's
+        perf stats so per-replica admission numbers are attributable."""
+        self.shard_group = None if group is None else int(group)
+        if self.scheduler is not None:
+            self.scheduler.tag["shard_group"] = self.shard_group
+        logger.info("rank %d registered shard_group=%s",
+                    self.rank, self.shard_group)
+        return self.shard_group
+
+    def sync_shard_from(self, index_id: str, host: str, port: int,
+                        shard_group: Optional[int] = None) -> dict:
+        """Online join: stream a live replica's shard and serve it.
+
+        Dials ``host:port`` (a live replica of the target group), fetches
+        its atomic export over a dedicated transfer connection
+        (rpc.Client.fetch_shard -> KIND_SHARD_FETCH/KIND_SHARD_DATA),
+        commits the snapshot into THIS rank's storage dir as a
+        manifest-committed generation, installs the restored engine
+        (replacing any stale local index), replays the buffer delta via
+        the normal async add path, and registers the shard group. The
+        serving loops keep answering other RPCs throughout — the only
+        exclusive section is the registry swap."""
+        src = rpc.Client(-1, host, port, connect_timeout=10.0, mux=False)
+        try:
+            snapshot = src.fetch_shard(index_id)
+        finally:
+            src.close()
+        index = Index.import_snapshot(
+            snapshot, self._get_storage_dir(index_id, None))
+        with self.indexes_lock:
+            old = self.indexes.get(index_id)
+            self.indexes[index_id] = index
+        if old is not None:
+            # the storage dir now belongs to the transferred shard: the
+            # superseded engine must never autosave its stale state over
+            # it as a newer generation
+            old.retire()
+        if shard_group is not None:
+            self.set_shard_group(shard_group)
+        buffered, ntotal = index.get_idx_data_num()
+        logger.info(
+            "rank %d joined via shard transfer from %s:%d (%s: %d rows, "
+            "%d buffered)", self.rank, host, port, index_id, ntotal, buffered)
+        return {"rank": self.rank, "index_id": index_id, "ntotal": ntotal,
+                "buffered": buffered, "generation": index._generation,
+                "shard_group": self.shard_group}
 
     def index_loaded(self, index_id: str) -> bool:
         with self.indexes_lock:
@@ -258,6 +327,11 @@ class IndexServer:
             out["rpc"] = {"in_flight": self._mux_inflight,
                           **self._mux_counters}
         out["rpc"]["workers"] = self._rpc_worker_count
+        # replica identity: which logical shard group this rank serves —
+        # the client merges its fan-out counters in under
+        # ``replication.client`` (parallel/replication.py)
+        out["replication"] = {"rank": self.rank,
+                              "shard_group": self.shard_group}
         with self.indexes_lock:
             snapshot = list(self.indexes.items())
         out["engine"] = {iid: idx.perf_stats() for iid, idx in snapshot}
@@ -384,6 +458,16 @@ class IndexServer:
         kind, payload = rpc.recv_frame(conn)
         if kind == rpc.KIND_CLOSE:
             raise rpc.ClientExit("client closed")
+        if kind == rpc.KIND_SHARD_FETCH:
+            # shard transfer rides its own dedicated connection (see
+            # rpc.Client.fetch_shard), but the bulk export + send must
+            # not occupy the reader — on the selector loop that thread
+            # serves EVERY connection — so it runs on the worker pool,
+            # serialized against any other writes by the connection's
+            # write lock
+            self._rpc_workers.submit(self._serve_shard_fetch, conn,
+                                     payload, wlock)
+            return
         if kind != rpc.KIND_CALL:
             raise RuntimeError(f"unexpected frame kind {kind}")
         # 3-tuple (legacy) or 4-tuple with frame meta carrying the caller's
@@ -422,6 +506,33 @@ class IndexServer:
                 with self._mux_lock:
                     self._mux_inflight -= 1
                 raise
+
+    def _serve_shard_fetch(self, conn: socket.socket, payload,
+                           wlock: Optional[threading.Lock] = None) -> None:
+        """Answer one KIND_SHARD_FETCH with the engine's atomic export as
+        a KIND_SHARD_DATA frame (failures degrade to a structured
+        KIND_ERROR — the fetching peer raises ServerException instead of
+        tearing the transfer connection down undiagnosed). Runs on the
+        worker pool; a peer that vanished mid-transfer costs a logged
+        OSError, never an unhandled worker exception."""
+        t0 = time.perf_counter()
+        try:
+            (index_id,) = tuple(payload)[:1]
+            snapshot = self._get_index(index_id).export_snapshot()
+            parts = rpc.pack_frame(rpc.KIND_SHARD_DATA, snapshot)
+            self.perf.record("fetch_shard", time.perf_counter() - t0)
+        except Exception:
+            tb = traceback.format_exc()
+            logger.error("shard fetch failed: %s", tb)
+            parts = rpc.pack_frame(rpc.KIND_ERROR, tb)
+        try:
+            if wlock is not None:
+                with wlock:
+                    rpc._send_parts(conn, parts)
+            else:
+                rpc._send_parts(conn, parts)
+        except OSError as e:
+            logger.info("shard transfer write failed (peer gone?): %s", e)
 
     def _classify_scheduler_reject(self, error):
         """Map a scheduler admission/shed error to its structured BUSY

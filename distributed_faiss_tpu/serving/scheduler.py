@@ -138,9 +138,14 @@ class SearchScheduler:
     """
 
     def __init__(self, search_fn: Callable, cfg: Optional[SchedulerCfg] = None,
-                 name: str = "search-batcher"):
+                 name: str = "search-batcher", tag: Optional[dict] = None):
         self._search_fn = search_fn
         self.cfg = cfg if cfg is not None else SchedulerCfg()
+        # replica identity riding the stats surface (replication layer):
+        # admission behavior is unchanged per replica, but operators need
+        # queue/shed numbers attributable to (rank, shard_group). Owned by
+        # the server, which updates shard_group on (re-)registration.
+        self.tag = dict(tag or {})
         self._cond = lockdep.condition("SearchScheduler._cond")
         self._queue: List[_Request] = []
         self._stopping = False
@@ -389,4 +394,7 @@ class SearchScheduler:
         with self._cond:
             counters = dict(self._counters)
             counters["queued"] = len(self._queue)
-        return {"counters": counters, "queues": self.stats.summary()}
+        out = {"counters": counters, "queues": self.stats.summary()}
+        if self.tag:
+            out["replica"] = dict(self.tag)
+        return out

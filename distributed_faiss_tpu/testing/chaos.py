@@ -314,14 +314,19 @@ class ServerHarness:
         proc.kill()
         proc.wait()
 
-    def restart(self, rank: int, load_index: bool = False) -> None:
-        """Re-spawn a killed rank on its original port."""
+    def restart(self, rank: int, load_index: bool = False,
+                extra_env: Optional[dict] = None) -> None:
+        """Re-spawn a killed rank on its original port. ``extra_env``
+        overlays per-rank environment for THIS spawn only — e.g.
+        ``DFT_SHARD_GROUP`` so a rejoining rank comes back pre-registered
+        into its replica group (replication membership)."""
         cmd = [sys.executable, "-m", "distributed_faiss_tpu.parallel.server",
                "--rank", str(rank), "--port", str(self.port(rank)),
                "--storage-dir", self.storage_dir]
         if load_index:
             cmd.append("--load-index")
-        proc = subprocess.Popen(cmd, env={**os.environ, **self.env})
+        proc = subprocess.Popen(
+            cmd, env={**os.environ, **self.env, **(extra_env or {})})
         with self._lock:
             self.procs[rank] = proc
 
@@ -352,3 +357,71 @@ class ServerHarness:
                 p.wait(timeout=10)
             except (OSError, subprocess.TimeoutExpired):
                 pass
+
+
+class QueryStorm:
+    """Live query load for fault windows: N client threads re-issue one
+    search in a tight loop while the test injects faults (SIGKILL a rank,
+    garble a link), then ``stop()`` hands back every (result, error)
+    observed. The replication acceptance gate asserts byte-identity of
+    every storm result against the healthy cluster's golden answer —
+    proving a rank death under load costs neither rows nor correctness.
+
+    ``allow_partial`` selects the degraded-read contract under test:
+    False (the default) means every storm search must be served complete
+    (replication failover), True tolerates the pre-replication partial
+    contract. Errors are collected, never raised into the storm threads.
+    """
+
+    def __init__(self, client, index_id: str, query, topk: int,
+                 threads: int = 4, allow_partial: bool = False,
+                 interval: float = 0.0):
+        self.client = client
+        self.index_id = index_id
+        self.query = query
+        self.topk = topk
+        self.allow_partial = allow_partial
+        self.interval = interval
+        self.num_threads = threads
+        self._stop = threading.Event()
+        self._lock = lockdep.lock("QueryStorm._lock")
+        self.results: List[tuple] = []
+        self.errors: List[BaseException] = []
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> "QueryStorm":
+        for i in range(self.num_threads):
+            t = threading.Thread(target=self._run, daemon=True,
+                                 name=f"query-storm-{i}")
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def __enter__(self) -> "QueryStorm":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                out = self.client.search(
+                    self.query, self.topk, self.index_id,
+                    allow_partial=self.allow_partial)
+            except Exception as e:
+                with self._lock:
+                    self.errors.append(e)
+            else:
+                with self._lock:
+                    self.results.append(out)
+            if self.interval:
+                time.sleep(self.interval)
+
+    def stop(self) -> Tuple[List[tuple], List[BaseException]]:
+        """End the storm and return (results, errors) collected so far."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30.0)
+        with self._lock:
+            return list(self.results), list(self.errors)

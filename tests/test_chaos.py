@@ -417,7 +417,7 @@ def test_write_path_retry_heals_reset_and_broadcast_reports_outage(tmp_path):
             before = list(client.reroutes)
             client.add_index_data("ridx", x[100:150],
                                   [(i,) for i in range(100, 150)])
-            assert client.reroutes == before, "retry healed, so no reroute"
+            assert list(client.reroutes) == before, "retry healed, so no reroute"
             assert proxy.connections_seen() >= 3  # dial + RST'd + healed
             wait_drained(client, "ridx", 150)
 

@@ -25,6 +25,28 @@ def test_read_server_list_ok(tmp_path):
     assert IndexClient.read_server_list(path) == [("a", 1), ("b", 2), ("c", 3)]
 
 
+def test_read_server_list_dedupes_restarted_rank(tmp_path):
+    """Regression (ISSUE 8 satellite): a RESTARTED rank re-appends its
+    ``host,port`` discovery line, pushing the raw entry count past the
+    advertised header — the old exact-count check then looped until the
+    7200 s timeout. Duplicates must dedupe (keeping registration order)
+    and the wait must accept len >= advertised."""
+    p = tmp_path / "servers.txt"
+    p.write_text("3\na,1\nb,2\nc,3\nb,2\n")  # rank b restarted and re-registered
+    assert IndexClient.read_server_list(str(p), total_max_timeout=1) == [
+        ("a", 1), ("b", 2), ("c", 3)]
+
+
+def test_read_server_list_accepts_extra_distinct_entries(tmp_path):
+    """A rank that moved ports mid-life leaves an extra DISTINCT entry:
+    connect to everything rather than hang (the dead entry degrades
+    through the normal transport-error paths)."""
+    p = tmp_path / "servers.txt"
+    p.write_text("2\na,1\nb,2\nb,3\n")
+    assert IndexClient.read_server_list(str(p), total_max_timeout=1) == [
+        ("a", 1), ("b", 2), ("b", 3)]
+
+
 def test_read_server_list_timeout(tmp_path):
     path = write_list(tmp_path, 4, [("a", 1), ("b", 2), ("c", 3)])
     with pytest.raises(RuntimeError) as ei:

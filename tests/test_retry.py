@@ -5,13 +5,19 @@ runs in tier-1; the live-cluster versions are in tests/test_chaos.py."""
 
 import random
 import threading
+from collections import deque
 from multiprocessing.dummy import Pool as ThreadPool
 
 import numpy as np
 import pytest
 
-from distributed_faiss_tpu.parallel import rpc
-from distributed_faiss_tpu.parallel.client import IndexClient, MultiRankError
+from distributed_faiss_tpu.parallel import replication, rpc
+from distributed_faiss_tpu.parallel.client import (
+    REROUTE_LOG_LEN,
+    IndexClient,
+    MultiRankError,
+)
+from distributed_faiss_tpu.utils.config import ReplicationCfg
 
 
 # ------------------------------------------------------------- RetryPolicy
@@ -145,7 +151,7 @@ class FakeStub:
         return f"ok-{self.id}"
 
 
-def make_client(stubs, retry=None):
+def make_client(stubs, retry=None, replication_cfg=None):
     c = object.__new__(IndexClient)
     c.sub_indexes = stubs
     c.num_indexes = len(stubs)
@@ -154,7 +160,17 @@ def make_client(stubs, retry=None):
     c._rng = random.Random(0)
     c.retry = retry or rpc.RetryPolicy(max_attempts=2, base_delay=0.001,
                                        jitter=0.0)
-    c.reroutes = []
+    c._stats_lock = threading.Lock()
+    c.reroutes = deque(maxlen=REROUTE_LOG_LEN)
+    c.counters = {"reroutes": 0, "failovers": 0,
+                  "under_replicated": 0, "quorum_failures": 0}
+    c.rcfg = replication_cfg or ReplicationCfg()
+    eff = min(c.rcfg.replication, max(len(stubs), 1))
+    c.quorum = replication.quorum_size(eff, min(c.rcfg.write_quorum, eff))
+    c.repair_queue = replication.RepairQueue(c.rcfg.repair_queue_len)
+    c._preferred = {}
+    c.membership = replication.MembershipTable(
+        replication.assign_groups(len(stubs), c.rcfg.replication))
     c.cfg = None
     return c
 
@@ -186,7 +202,7 @@ def test_add_index_data_transient_failure_retries_same_rank():
 
     client.add_index_data("idx", np.zeros((2, 8), np.float32), [1, 2])
     assert len(flaky.acked) == 1  # retry healed in place: no reroute
-    assert client.reroutes == []
+    assert list(client.reroutes) == []
     assert client.cur_server_ids["idx"] == 1
 
 
@@ -207,7 +223,7 @@ def test_add_index_data_application_error_propagates():
     client.cur_server_ids["idx"] = 0
     with pytest.raises(rpc.ServerException):
         client.add_index_data("idx", np.zeros((1, 8), np.float32), [1])
-    assert other.acked == [] and client.reroutes == []
+    assert other.acked == [] and list(client.reroutes) == []
 
 
 def test_broadcast_success_returns_rank_ordered_results():

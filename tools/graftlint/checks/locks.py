@@ -62,6 +62,23 @@ PINS = {
     ("Client", "_peer_tagged"): "_lock",
     ("IndexServer", "_mux_inflight"): "_mux_lock",
     ("IndexServer", "_mux_counters"): "_mux_lock",
+    # replication membership/repair state (parallel/replication.py,
+    # parallel/client.py): the group table is read by every fan-out and
+    # rewritten by online join/leave; the repair queue is appended by the
+    # write path and drained by the background repair pass; the client's
+    # reroute ring, fan-out counters, and per-group read pins are shared
+    # between user threads and the fan-out pool's workers
+    ("MembershipTable", "_groups"): "_lock",
+    ("MembershipTable", "_group_of"): "_lock",
+    ("RepairQueue", "_items"): "_lock",
+    ("RepairQueue", "_counters"): "_lock",
+    ("IndexClient", "reroutes"): "_stats_lock",
+    ("IndexClient", "counters"): "_stats_lock",
+    ("IndexClient", "_preferred"): "_stats_lock",
+    # chaos query-storm collector (testing/chaos.py): results/errors are
+    # appended by N storm threads and drained by stop()
+    ("QueryStorm", "results"): "_lock",
+    ("QueryStorm", "errors"): "_lock",
 }
 
 _SKIP_METHODS = frozenset({"__init__", "__new__", "__del__"})
