@@ -352,6 +352,135 @@ def run_mesh_arms(arm, n_threads=8, batch=32, reps=4, k=10):
     return out
 
 
+def run_churn_arm(n_threads=8, batch=32, reps=8, k=10):
+    """Mutable-corpora churn arm (mutation subsystem): interleaved
+    delete/upsert under a live query storm, with and without an active
+    compaction pass.
+
+      churn_idle       — baseline: storm only, no mutations
+      churn_mutating   — storm + a mutator thread upserting/deleting ids
+                         between launches (tombstones accumulate)
+      churn_compacting — same storm while a compaction pass rewrites 30%
+                         tombstoned rows into a fresh generation mid-run
+                         (phase 2 overlaps serving; the commit+swap holds
+                         the engine locks briefly)
+
+    Identity is asserted the strong way: after every arm, no deleted id
+    may appear in a verification search.
+    """
+    import tempfile
+
+    from distributed_faiss_tpu.engine import Index
+    from distributed_faiss_tpu.utils.config import IndexCfg
+    from distributed_faiss_tpu.utils.state import IndexState
+    import jax
+
+    backend = jax.devices()[0].platform
+    small = os.environ.get("BENCH_SMALL") == "1"
+    n = 20_000 if small else 200_000
+    d = 128
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    tmp = tempfile.mkdtemp(prefix="dft-churn-")
+    os.environ["DFT_COMPACT"] = "0"  # the arm drives compaction explicitly
+    cfg = IndexCfg(index_builder_type="ivfsq", dim=d, metric="l2",
+                   train_num=min(n, 50_000), centroids=128, nprobe=4,
+                   index_storage_dir=os.path.join(tmp, "shard"))
+    idx = Index(cfg)
+    idx.add_batch(x, [(i,) for i in range(n)],
+                  train_async_if_triggered=False)
+    idx.train()
+    deadline = time.time() + 1800
+    while (idx.get_state() != IndexState.TRAINED
+           or idx.get_idx_data_num()[0] > 0):
+        assert time.time() < deadline, "churn arm train/drain timed out"
+        time.sleep(0.5)
+    queries = [
+        x[rng.integers(0, n, batch)] + 0.01 for _ in range(n_threads)]
+    idx.search(queries[0], k)  # warm the jit cache
+
+    def storm(extra=None):
+        stop = threading.Event()
+        state = {"mutations": 0}
+        side = None
+        if extra is not None:
+            side = threading.Thread(target=extra, args=(stop, state),
+                                    daemon=True)
+            side.start()
+        def churn_search(q, kk):
+            # ride through the engine's transient mid-ADD rejection (the
+            # drain window an R>=2 client fails over across — the retry
+            # wait is honest single-replica serving cost here)
+            while True:
+                try:
+                    return idx.search(q, kk)
+                except RuntimeError as e:
+                    if "IndexState.ADD" not in str(e):
+                        raise
+                    time.sleep(0.0005)
+
+        qps, p99 = run_clients(churn_search, queries, n_threads, reps, k)
+        stop.set()
+        if side is not None:
+            side.join(timeout=60)
+        return qps, p99, state
+
+    def mutator(stop, state):
+        mrng = np.random.default_rng(11)
+        next_id = n
+        while not stop.is_set():
+            victims = mrng.integers(0, n, 8).tolist()
+            idx.remove_ids(victims)
+            # upsert: re-add half of them with fresh vectors
+            up = victims[:4]
+            idx.upsert(up, mrng.standard_normal((4, d)).astype(np.float32),
+                       [(i,) for i in up])
+            state["mutations"] += 12
+            next_id += 4
+            time.sleep(0.002)
+
+    rows = []
+    qps, p99, _ = storm()
+    rows.append({"case": "churn_idle", "backend": backend,
+                 "threads": n_threads, "batch": batch,
+                 "qps": round(qps, 1), "p99_ms": round(p99, 2)})
+
+    qps, p99, st = storm(mutator)
+    rows.append({"case": "churn_mutating", "backend": backend,
+                 "threads": n_threads, "batch": batch,
+                 "qps": round(qps, 1), "p99_ms": round(p99, 2),
+                 "mutations": st["mutations"]})
+
+    # cross the compaction threshold, then run the storm with the pass
+    # live. The previous arm's upserts may still be draining when this
+    # starts; compact() aborts (returns False) if an ADD lands
+    # mid-rebuild, so retry until a pass commits — an uncaught assert in
+    # a daemon thread would otherwise surface only minutes later as an
+    # undiagnosable compactions==0 failure.
+    idx.remove_ids(list(range(0, int(0.3 * n), 1)))
+
+    def compactor(stop, state):
+        deadline = time.time() + 120
+        while not idx.compact():
+            assert time.time() < deadline, "compaction never committed"
+            time.sleep(0.2)
+
+    qps, p99, st = storm(compactor)
+    mu = idx.mutation_stats()
+    rows.append({"case": "churn_compacting", "backend": backend,
+                 "threads": n_threads, "batch": batch,
+                 "qps": round(qps, 1), "p99_ms": round(p99, 2),
+                 "compactions": mu["compactions"],
+                 "compaction_s": round(
+                     mu.get("compaction_s", {}).get("max_s", 0.0), 3)})
+    assert mu["compactions"] >= 1, mu
+    # the strong check: no tombstoned id in a verification search
+    d_, m_, _ = idx.search(x[:64], k)
+    dead = {(i,) for i in range(0, int(0.3 * n))}
+    assert not any(mm in dead for row in m_ for mm in row)
+    return rows
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -376,6 +505,11 @@ def main():
         help="mesh-sharded serving A/B arm(s) on a virtual 8-device CPU "
              "mesh (forces XLA_FLAGS before jax imports; default: none — "
              "run with --mesh both for the one-launch-per-window check)")
+    parser.add_argument(
+        "--churn", choices=("on", "none"), default="none",
+        help="mutable-corpora churn arm: interleaved delete/upsert under a "
+             "live query storm, with and without an active compaction pass "
+             "(default: none)")
     parser.add_argument(
         "--modes", default="percall,natural,window",
         help="comma list of legacy batcher modes to run ('' = skip)")
@@ -481,6 +615,11 @@ def main():
             # the ISSUE 6 acceptance: every merged window crossed to the
             # mesh as exactly ONE pjit launch
             assert r["launches_per_window_max"] == 1.0, r
+
+    if args.churn != "none":
+        for row in run_churn_arm(n_threads=n_threads, batch=batch,
+                                 reps=reps, k=k):
+            print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":

@@ -30,16 +30,26 @@ from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
-from distributed_faiss_tpu.models.factory import build_index, index_from_state_dict
+from distributed_faiss_tpu.models.factory import (
+    build_index,
+    index_from_state_dict,
+    remove_rows_unsupported,
+)
+from distributed_faiss_tpu.mutation import compaction as _compaction
+from distributed_faiss_tpu.mutation import tombstones as _tombstones
+from distributed_faiss_tpu.mutation.tombstones import TombstoneSet
 from distributed_faiss_tpu.utils import lockdep, serialization
 from distributed_faiss_tpu.utils.batching import SearchBatcher
-from distributed_faiss_tpu.utils.config import IndexCfg
+from distributed_faiss_tpu.utils.config import IndexCfg, MutationCfg
 from distributed_faiss_tpu.utils.serialization import (
     atomic_write,
     load_state,
     save_state,
 )
-from distributed_faiss_tpu.utils.state import IndexState
+from distributed_faiss_tpu.utils.state import (
+    NOT_TRAINED_REJECTION_FMT,
+    IndexState,
+)
 from distributed_faiss_tpu.utils.tracing import LatencyStats
 
 logger = logging.getLogger()
@@ -116,6 +126,51 @@ class _MetaStore:
         return self._arr[: self._n].tolist()
 
 
+def _id_match_key(v):
+    """Normalize a metadata id for cross-layout sidecar matching: JSON
+    round-trips tuples as lists and stringifies everything it can't
+    serialize, so both sides reduce to (recursively) tuple-ized values or
+    their str() as the last resort."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_id_match_key(e) for e in v)
+    if isinstance(v, (int, float, str, bool)):
+        return v
+    return str(v)
+
+
+def _apply_sidecar_by_id(tomb: "TombstoneSet", side: dict, meta: list,
+                         id_idx: int, storage_dir: str) -> None:
+    """Cross-layout tombstone recovery: the standalone sidecar's POSITIONS
+    belong to a layout that did not survive (a compacted generation that
+    tore before the crash), but its id-keyed record is layout-independent
+    — re-derive the dead rows by scanning the loaded metadata for those
+    ids. Conservative by design: an id that was deleted and then re-added
+    inside the lost layout is re-deleted here (a delete must never
+    resurrect; re-ingest restores the upsert)."""
+    ids = set()
+    for v in side.get("dead_ids", ()):
+        if v is None:
+            continue
+        ids.add(_id_match_key(v))
+    if not ids:
+        return
+    hits = 0
+    for p, m in enumerate(meta):
+        if not m:
+            continue
+        try:
+            mid = m[id_idx]
+        except (TypeError, IndexError, KeyError):
+            continue
+        if _id_match_key(mid) in ids and p not in tomb:
+            tomb.add([p], [mid])
+            hits += 1
+    logger.warning(
+        "tombstone sidecar at %s is keyed to layout %s but generation "
+        "layout is %s: re-applied %d delete(s) BY ID onto the fallback "
+        "layout", storage_dir, side.get("layout"), tomb.layout, hits)
+
+
 def get_index_files(index_storage_dir: str) -> Tuple[str, str, str, str]:
     """LEGACY flat file layout per shard (reference: index.py:103-108,
     .faiss -> .npz). Saves now write generation-suffixed sets committed by
@@ -172,6 +227,35 @@ class Index:
         # (0 = nothing committed yet; from_storage_dir seeds it on restore)
         self._generation = 0
 
+        # ---- mutation subsystem (mutation/) ----
+        # positional dead-row set + id record; guarded by index_lock (the
+        # same lock the device mask scatter and every device search hold,
+        # which is what makes a scheduler-merged window see one consistent
+        # tombstone snapshot — never a torn mask mid-window)
+        self.tombstones = TombstoneSet()
+        self._mutation_counters = {
+            "compactions": 0, "compactions_aborted": 0, "load_fallbacks": 0,
+        }
+        # standalone-sidecar writer: mutations snapshot their payload (and
+        # a version) under the engine locks but perform the JSON
+        # rewrite+fsync OUTSIDE them — a delete storm must not stall the
+        # serving path on disk I/O. The version gate keeps last-writer-
+        # wins correct: a later version's payload is always a superset
+        # (the set only shrinks at a compaction swap, which bumps the
+        # version under the same locks), so a stale writer just skips.
+        self._tombstone_io_lock = lockdep.lock("Index._tombstone_io_lock")
+        self._tombstone_version = 0  # guarded by index_lock
+        self._tombstone_written = 0  # guarded by _tombstone_io_lock
+        # metadata layout epoch (seqlock): bumped under BOTH locks whenever
+        # the positional row layout is replaced (compaction swap,
+        # drop_index), so a search that launched on the old layout retries
+        # its metadata join instead of joining old ids to new metadata.
+        # Guarded by buffer_lock (the join side).
+        self._meta_epoch = 0
+        self.mutation_cfg = MutationCfg.from_env()
+        if self.mutation_cfg.compact and cfg.index_storage_dir:
+            self._run_compaction_watcher()
+
         # concurrent searches coalesce into shared device launches
         # (launch-bound serving — utils/batching.py); window 0 = natural
         # batching only, no added latency
@@ -190,9 +274,12 @@ class Index:
             self.embeddings_buffer = []
             self.total_data = 0
             self.id_to_metadata = _MetaStore()
+            # layout replaced: in-flight joins against the old index retry
+            self._meta_epoch += 1
         with self.index_lock:
             self.tpu_index = None
             self.state = IndexState.NOT_TRAINED
+            self.tombstones = TombstoneSet(layout=self.tombstones.layout)
 
     def add_batch(
         self,
@@ -222,6 +309,324 @@ class Index:
                 _thread.start_new_thread(self.train, ())
             else:
                 self.train()
+
+    # ---------------------------------------------------------------- mutation
+
+    def remove_ids(self, ids) -> int:
+        """Tombstone every row whose metadata id (``cfg.custom_meta_id_idx``)
+        is in ``ids``. Returns the number of rows newly tombstoned.
+
+        Indexed rows are masked on device immediately (one scatter under
+        ``index_lock`` — the same lock every device search holds, so a
+        merged window is entirely pre- or post-delete, never torn).
+        Buffer-aware: rows still in the add buffer keep their positional
+        slot and are masked the moment their drain chunk lands
+        (_add_buffer_to_idx), so an id deleted mid-ingest never serves.
+        The updated tombstone set is persisted to the standalone sidecar
+        (tmp+fsync+rename) BEFORE this returns — a crash after an
+        acknowledged delete can never resurrect the rows, whatever
+        generation the restart falls back to (mutation/tombstones.py).
+
+        The O(rows) id -> row scan runs OUTSIDE the locks against the
+        append-only metadata snapshot (the same contract the search-time
+        join rides), so a delete storm does not stall the serving path;
+        only the (tiny) tail appended after the snapshot is re-scanned
+        under the locks, keeping "every matching row present at call
+        time" exact.
+        """
+        id_set = ids if isinstance(ids, (set, frozenset)) else set(ids)
+        if not id_set:
+            return 0
+        id_idx = self.cfg.custom_meta_id_idx
+
+        def scan(meta_arr, lo, hi):
+            found = []
+            for p in range(lo, hi):
+                meta = meta_arr[p]
+                if not meta:
+                    continue
+                try:
+                    mid = meta[id_idx]
+                except (TypeError, IndexError, KeyError):
+                    continue
+                if mid in id_set:
+                    found.append((p, mid))
+            return found
+
+        with self.buffer_lock:
+            epoch0 = self._meta_epoch
+            meta_arr0, meta_n0 = self.id_to_metadata.snapshot()
+        candidates = scan(meta_arr0, 0, meta_n0)  # O(rows), lock-free
+
+        with self.buffer_lock, self.index_lock:
+            meta_arr, meta_n = self.id_to_metadata.snapshot()
+            if self._meta_epoch != epoch0:
+                # a compaction/drop swapped the positional layout between
+                # the lock-free scan and this point: the candidate
+                # positions are stale — rescan fully under the locks
+                # (rare; the swap itself is rare)
+                candidates = scan(meta_arr, 0, meta_n)
+            else:
+                candidates += scan(meta_arr, meta_n0, meta_n)
+            indexed_n = (self.tpu_index.ntotal
+                         if self.tpu_index is not None else 0)
+            rows, rids = [], []
+            for p, mid in candidates:
+                if p not in self.tombstones:
+                    rows.append(p)
+                    rids.append(mid)
+            if not rows:
+                return 0
+            self._check_remove_supported_locked()
+            device_rows = [p for p in rows if p < indexed_n]
+            if device_rows:
+                # graftlint: ok(blocking-under-lock): the locked mask scatter is the tombstone consistency contract — device mutations serialize on index_lock like every launch
+                self.tpu_index.remove_rows(np.asarray(device_rows, np.int64))
+            self.tombstones.add(rows, rids)
+            payload, version = self._tombstone_payload_locked()
+            removed = len(rows)
+        # durability point — AFTER the serving locks are released: the
+        # sidecar rewrite+fsync must not stall concurrent searches/adds
+        self._write_tombstone_sidecar(payload, version)
+        return removed
+
+    def upsert(self, ids, embeddings: np.ndarray,
+               metadata: Optional[List[object]] = None) -> int:
+        """Delete + add: tombstone every live row carrying one of ``ids``,
+        then ingest the replacement vectors through the normal add path
+        (new rows get fresh positions, so they are NOT masked by the ids'
+        tombstones — those are positional). Returns the rows tombstoned.
+
+        Visibility ordering: the old rows stop serving before this call
+        returns; the new rows become searchable when their buffer chunk
+        drains (exactly like any add) — old and new are never both live.
+        ``metadata`` defaults to ``(id,)`` tuples when the id rides at
+        metadata position 0 (the default ``custom_meta_id_idx``)."""
+        ids = list(ids)
+        embeddings = np.asarray(embeddings, np.float32)
+        if embeddings.shape[0] != len(ids):
+            raise RuntimeError(
+                "upsert ids length should match the batch size of the "
+                "embeddings")
+        if metadata is None:
+            if self.cfg.custom_meta_id_idx != 0:
+                raise RuntimeError(
+                    "upsert needs explicit metadata when "
+                    "custom_meta_id_idx != 0")
+            metadata = [(i,) for i in ids]
+        removed = self.remove_ids(ids)
+        self.add_batch(embeddings, metadata)
+        return removed
+
+    # graftlint: ok(lock-discipline): the _locked suffix is the contract — every caller holds index_lock
+    def _check_remove_supported_locked(self) -> None:
+        """Reject remove/upsert on index kinds without a tombstone mask
+        BEFORE any tombstone is recorded — including when every matching
+        row is still in the add buffer (``tpu_index`` may not even exist
+        yet): accepting such a delete and letting the drain-time mask hit
+        the base-class rejection would kill the drain worker and wedge
+        the engine in ``ADD`` forever."""
+        if self.tpu_index is not None:
+            if not self.tpu_index.supports_remove_rows():
+                raise RuntimeError(
+                    f"{type(self.tpu_index).__name__} does not support "
+                    "remove/upsert (no tombstone mask for this index kind)")
+        elif remove_rows_unsupported(self.cfg):
+            kind = self.cfg.index_builder_type or self.cfg.faiss_factory
+            raise RuntimeError(
+                f"index kind {kind!r} does not support remove/upsert "
+                "(no tombstone mask for this index kind)")
+
+    # graftlint: ok(lock-discipline): the _locked suffix is the contract — every caller holds index_lock
+    def _tombstone_payload_locked(self):
+        """Snapshot the sidecar payload + a monotonic version under the
+        engine locks; the disk write happens outside them
+        (_write_tombstone_sidecar)."""
+        self._tombstone_version += 1
+        return self.tombstones.to_payload(), self._tombstone_version
+
+    def _write_tombstone_sidecar(self, payload: dict, version: int) -> None:
+        """Rewrite the standalone sidecar (atomic tmp+fsync+rename) — the
+        per-mutation durability point, serialized by its own writer lock
+        so it never rides the serving locks. Version-gated: if a newer
+        payload (a superset — the set only shrinks at a compaction swap,
+        which also bumps the version) already landed, skip. No-op for
+        storage-less engines (pure in-memory shards keep the in-memory
+        set only)."""
+        storage_dir = self.cfg.index_storage_dir
+        if not storage_dir:
+            return
+        with self._tombstone_io_lock:
+            if version <= self._tombstone_written:
+                return
+            os.makedirs(storage_dir, exist_ok=True)
+            # graftlint: ok(blocking-under-lock): dedicated leaf writer lock — ordering for the sidecar file only, never held with the serving locks
+            _tombstones.write_sidecar(storage_dir, payload)
+            self._tombstone_written = version
+
+    def tombstone_fraction(self) -> float:
+        """Tombstoned fraction of the INDEXED rows (the compaction
+        trigger; buffered dead rows reclaim themselves on drain+compact)."""
+        with self.index_lock:
+            indexed_n = (self.tpu_index.ntotal
+                         if self.tpu_index is not None else 0)
+            if indexed_n == 0:
+                return 0.0
+            return self.tombstones.count_below(indexed_n) / indexed_n
+
+    def mutation_stats(self) -> dict:
+        """The ``mutation`` perf-stats key (served per index through
+        IndexServer.get_perf_stats): tombstone counts, live fraction,
+        compaction counters (run / aborted mid-swap / generation
+        fallbacks at load), the layout epoch, and the ``compaction_s``
+        latency summary when any pass has run."""
+        with self.index_lock:
+            indexed_n = (self.tpu_index.ntotal
+                         if self.tpu_index is not None else 0)
+            dead_indexed = self.tombstones.count_below(indexed_n)
+            out = {
+                "tombstoned_rows": len(self.tombstones),
+                "tombstoned_indexed": dead_indexed,
+                "live_fraction": (
+                    1.0 - dead_indexed / indexed_n if indexed_n else 1.0),
+                "layout_generation": self.tombstones.layout,
+                **self._mutation_counters,
+            }
+        comp = self.perf.summary().get("compaction_s")
+        if comp:
+            out["compaction_s"] = comp
+        return out
+
+    def compact(self) -> bool:
+        """Rewrite tombstoned rows out of the index as a fresh MANIFEST
+        generation, swapped in atomically. Returns True when a compaction
+        committed.
+
+        Three phases (the serving-liveness / crash-safety split):
+
+        1. snapshot under both locks (state_dict + row count + dead set —
+           the same atomic capture a save makes);
+        2. rebuild WITHOUT locks: filter the state to survivors
+           (mutation/compaction.py — encoded payloads copied verbatim,
+           lists rebuilt tight) and construct the new index; serving
+           continues on the old one throughout;
+        3. back under both locks: abort if an ADD drained new rows since
+           the snapshot (the pass retries at the next interval), replay
+           deletes that arrived mid-rebuild onto the new layout, commit
+           the generation — rows, compacted metadata, buffer, AND the
+           remapped tombstone sidecar, all sha256-manifested with the new
+           layout epoch — then swap index/metadata/tombstones and bump the
+           layout epoch so in-flight joins retry.
+
+        Crash windows: SIGKILL during phase 2 leaves at most uncommitted
+        orphan files (quarantined at load; previous generation + its
+        layout-matched sidecar serve, tombstones intact). SIGKILL inside
+        phase 3 after the manifest landed loads the NEW generation, whose
+        own sidecar already carries the catch-up set; the standalone
+        sidecar — rewritten later in the same lock hold — is then stale by
+        layout and ignored. No interleaving mutation can slip between the
+        two writes because both happen under the engine locks.
+        """
+        storage_dir = self.cfg.index_storage_dir
+        if not storage_dir:
+            return False
+        t0 = time.perf_counter()
+        with self.buffer_lock, self.index_lock:
+            if self.tpu_index is None or self.state != IndexState.TRAINED:
+                return False
+            n0 = int(self.tpu_index.ntotal)
+            dead0 = np.asarray(
+                [p for p in self.tombstones.rows() if p < n0], np.int64)
+            if dead0.size == 0:
+                return False
+            # graftlint: ok(blocking-under-lock): designed locked fetch — the compaction snapshot must capture one atomic index state (same contract as _maybe_save)
+            state = self.tpu_index.state_dict()
+
+        # ---- phase 2: rebuild with serving live ----
+        delay = float(os.environ.get("DFT_COMPACT_TEST_DELAY_S", "0") or 0)
+        if delay:
+            # chaos-test hook: widen the mid-pass window so the SIGKILL
+            # gate can land deterministically inside an uncommitted rebuild
+            time.sleep(delay)
+        keep = np.ones(n0, bool)
+        keep[dead0] = False
+        try:
+            new_state = _compaction.compact_state(state, keep)
+        except _compaction.CompactionUnsupported as e:
+            logger.info("compaction skipped: %s", e)
+            return False
+        new_index = index_from_state_dict(new_state)
+        new_n = int(keep.sum())
+        old2new = np.full(n0, -1, np.int64)
+        old2new[keep] = np.arange(new_n)
+
+        # ---- phase 3: catch-up + commit + swap ----
+        with self.buffer_lock, self.index_lock:
+            if (self.tpu_index is None or self.state != IndexState.TRAINED
+                    or int(self.tpu_index.ntotal) != n0):
+                # an ADD drained (or a drop/transfer swapped the engine)
+                # mid-rebuild: the snapshot's positional layout is stale —
+                # abort cheaply, the watcher retries against fresh state
+                self._mutation_counters["compactions_aborted"] += 1
+                logger.info("compaction aborted: index changed mid-rebuild")
+                return False
+            meta = self.id_to_metadata.tolist()
+            new_meta = [meta[p] for p in range(n0) if keep[p]] + meta[n0:]
+            # deletes that landed after the snapshot: remap onto the new
+            # layout (rows the rebuild already dropped map to -1)
+            shift = new_n - n0
+            carried = {}
+            for p, mid in self.tombstones.items():
+                if p >= n0:
+                    carried[p + shift] = mid  # buffered rows shift down
+                elif keep[p]:
+                    carried[int(old2new[p])] = mid
+            new_tomb = TombstoneSet(carried)
+            if any(r < new_n for r in carried):
+                # graftlint: ok(blocking-under-lock): locked mask scatter (tombstone consistency contract)
+                new_index.remove_rows(np.asarray(
+                    [r for r in carried if r < new_n], np.int64))
+            disk_gens = serialization.list_generations(storage_dir)
+            gen = max(self._generation,
+                      disk_gens[0][0] if disk_gens else 0) + 1
+            new_tomb.layout = gen
+            # claim the sidecar version gate BEFORE the commit writes the
+            # remapped payload: a remove_ids writer that snapshotted
+            # before this swap (stale layout) must skip afterwards, never
+            # overwrite the new-layout sidecar — and the engine locks keep
+            # any NEW mutation out until the swap below completes
+            self._tombstone_version += 1
+            with self._tombstone_io_lock:
+                self._tombstone_written = max(self._tombstone_written,
+                                              self._tombstone_version)
+            self._commit_generation(
+                storage_dir, gen, new_state, new_meta,
+                self.embeddings_buffer, self.cfg,
+                extra={"ntotal": new_n, "layout": gen, "compacted": True},
+                tombstones=new_tomb.to_payload(),
+                io_lock=self._tombstone_io_lock,
+            )
+            self.tpu_index = new_index
+            self.id_to_metadata = _MetaStore(new_meta)
+            self.tombstones = new_tomb
+            self._generation = gen
+            self.index_saved_size = new_n
+            self.index_save_time = time.time()
+            self._meta_epoch += 1  # in-flight joins retry on the new layout
+            self._mutation_counters["compactions"] += 1
+        dt = time.perf_counter() - t0
+        self.perf.record("compaction_s", dt)
+        logger.info(
+            "compacted %d tombstoned rows out (%d -> %d live) into "
+            "generation %d in %.3fs", n0 - new_n, n0, new_n, gen, dt)
+        return True
+
+    def _run_compaction_watcher(self) -> None:
+        name = os.path.basename(self.cfg.index_storage_dir or "?")
+        t = threading.Thread(
+            target=_compaction.run_watcher, args=(self, self.mutation_cfg),
+            name=f"compaction:{name}", daemon=True)
+        t.start()
 
     def get_idx_data_num(self) -> Tuple[int, int]:
         with self.buffer_lock:
@@ -354,6 +759,27 @@ class Index:
                     return
                 self.tpu_index.add(add_data)
                 ntotal = self.tpu_index.ntotal
+                # buffer-aware deletes: rows tombstoned while they were
+                # still buffered keep their positional slot (the metadata
+                # join is positional), so they are added like any row and
+                # masked immediately — under the SAME lock hold, so no
+                # search window can see them live
+                dead_new = self.tombstones.rows_in_range(
+                    ntotal - add_data.shape[0], ntotal)
+                if dead_new:
+                    # unreachable for unsupported kinds (remove_ids rejects
+                    # them up front, so tombstones only exist on maskable
+                    # indexes) — but a mask failure here must never kill
+                    # the drain worker: that would wedge the engine in ADD
+                    # and every search would fail over around it forever
+                    try:
+                        # graftlint: ok(blocking-under-lock): the locked mask scatter is the tombstone consistency contract — device mutations serialize on index_lock like every launch
+                        self.tpu_index.remove_rows(
+                            np.asarray(dead_new, np.int64))
+                    except Exception:
+                        logger.exception(
+                            "drain-time tombstone mask failed for rows %s "
+                            "— rows serve until compaction", dead_new)
             logger.info(
                 "added %d vectors in %.3fs (ntotal=%d)",
                 add_data.shape[0], time.time() - start_time, ntotal,
@@ -390,7 +816,8 @@ class Index:
         dispatch), both served through ``perf_stats``."""
         with self.index_lock:
             if self.state != IndexState.TRAINED:
-                raise RuntimeError(f"Server index is not trained. state: {self.state}")
+                raise RuntimeError(
+                    NOT_TRAINED_REJECTION_FMT.format(state=self.state))
             launches0 = getattr(self.tpu_index, "launches", None)
             t0 = time.perf_counter()
             out = self.tpu_index.search_batched(query_batch, top_k)
@@ -404,6 +831,28 @@ class Index:
                         "rows_per_launch", query_batch.shape[0] / launches)
             return out
 
+    def _run_and_join(self, run, return_embeddings: bool):
+        """Launch + metadata join under the layout-epoch seqlock.
+
+        ``run()`` returns (scores, indexes, embs_arr|None). A compaction
+        swap (or drop/recreate) between the device launch and the join
+        would pair OLD positional ids with the NEW metadata layout —
+        silent wrong-metadata results. The epoch (bumped under both locks
+        by every layout replacement) detects the overlap and relaunches
+        on the new layout instead."""
+        for _ in range(8):
+            with self.buffer_lock:
+                epoch0 = self._meta_epoch
+            scores, indexes, embs_arr = run()
+            with self.buffer_lock:
+                if self._meta_epoch != epoch0:
+                    continue  # layout swapped mid-flight: retry on the new one
+                meta_arr, meta_n = self.id_to_metadata.snapshot()
+            return self._join_results(scores, indexes, embs_arr,
+                                      return_embeddings, meta_arr, meta_n)
+        raise RuntimeError(
+            "metadata layout kept changing during search (compaction storm)")
+
     def search(
         self, query_batch: np.ndarray, top_k: int = 100, return_embeddings: bool = False
     ) -> Tuple[np.ndarray, List[List[object]], Optional[List[List[np.ndarray]]]]:
@@ -411,12 +860,10 @@ class Index:
         if not return_embeddings:
             # hot path: concurrent callers share device launches (state
             # re-checked under the lock inside _device_search)
-            scores, indexes = self._batcher.search(query_batch, top_k)
-            embs_arr = None
+            run = lambda: self._batcher.search(query_batch, top_k) + (None,)
         else:
-            scores, indexes, embs_arr = self._search_reconstruct(
-                query_batch, top_k)
-        return self._join_results(scores, indexes, embs_arr, return_embeddings)
+            run = lambda: self._search_reconstruct(query_batch, top_k)
+        return self._run_and_join(run, return_embeddings)
 
     def search_batched(
         self, query_batch: np.ndarray, top_k: int = 100, return_embeddings: bool = False
@@ -435,12 +882,10 @@ class Index:
         the contract (see ``_device_search``)."""
         query_batch = np.asarray(query_batch, np.float32)
         if not return_embeddings:
-            scores, indexes = self._device_search(query_batch, top_k)
-            embs_arr = None
+            run = lambda: self._device_search(query_batch, top_k) + (None,)
         else:
-            scores, indexes, embs_arr = self._search_reconstruct(
-                query_batch, top_k)
-        return self._join_results(scores, indexes, embs_arr, return_embeddings)
+            run = lambda: self._search_reconstruct(query_batch, top_k)
+        return self._run_and_join(run, return_embeddings)
 
     # graftlint: ok(blocking-under-lock): deliberate locked launches — ids and reconstructed embeddings must come from one atomic index state
     def _search_reconstruct(self, query_batch: np.ndarray, top_k: int):
@@ -450,7 +895,7 @@ class Index:
         with self.index_lock:
             if self.state != IndexState.TRAINED:
                 raise RuntimeError(
-                    f"Server index is not trained. state: {self.state}")
+                    NOT_TRAINED_REJECTION_FMT.format(state=self.state))
             t0 = time.perf_counter()
             scores, indexes = self.tpu_index.search(query_batch, top_k)
             self.perf.record("reconstruct_search_s",
@@ -466,12 +911,13 @@ class Index:
             embs_arr = rec.reshape(indexes.shape + (query_batch.shape[1],))
         return scores, indexes, embs_arr
 
-    def _join_results(self, scores, indexes, embs_arr, return_embeddings):
-        # vectorized metadata join: lock held only for the snapshot; safe
-        # outside the lock because the store is append-only past the
-        # snapshotted length (see _MetaStore docstring)
-        with self.buffer_lock:
-            meta_arr, meta_n = self.id_to_metadata.snapshot()
+    def _join_results(self, scores, indexes, embs_arr, return_embeddings,
+                      meta_arr, meta_n):
+        # vectorized metadata join: the caller (_run_and_join) snapshots
+        # (meta_arr, meta_n) under buffer_lock AFTER verifying the layout
+        # epoch; the join itself is safe outside the lock because the
+        # store is append-only past the snapshotted length (see _MetaStore
+        # docstring)
         valid = indexes != -1
         # single host-side pass (invalid slots are -1, always < meta_n, so
         # the max doubles as the valid-id check)
@@ -522,14 +968,17 @@ class Index:
 
     def get_ids(self) -> set:
         id_idx = self.cfg.custom_meta_id_idx
-        # Snapshot under buffer_lock (torn-read guard, reference
-        # index.py:367-368), then build the set outside: the O(ntotal)
-        # Python iteration must not stall concurrent add_index_data. Safe
-        # because the store is append-only past the snapshotted length
-        # (_MetaStore docstring).
-        with self.buffer_lock:
+        # Snapshot under the locks (torn-read guard, reference
+        # index.py:367-368; tombstones ride index_lock), then build the
+        # set outside: the O(ntotal) Python iteration must not stall
+        # concurrent add_index_data. Safe because the store is append-only
+        # past the snapshotted length (_MetaStore docstring).
+        with self.buffer_lock, self.index_lock:
             meta_arr, meta_n = self.id_to_metadata.snapshot()
-        return {meta[id_idx] for meta in meta_arr[:meta_n].tolist() if meta}
+            dead = frozenset(self.tombstones.rows())
+        return {meta[id_idx]
+                for p, meta in enumerate(meta_arr[:meta_n].tolist())
+                if meta and p not in dead}
 
     def upd_cfg(self, cfg: IndexCfg) -> None:
         self.cfg = cfg
@@ -591,7 +1040,10 @@ class Index:
             self._commit_generation(
                 storage_dir, gen, state, self.id_to_metadata.tolist(),
                 self.embeddings_buffer, self.cfg,
-                extra={"ntotal": int(self.tpu_index.ntotal)},
+                extra={"ntotal": int(self.tpu_index.ntotal),
+                       "layout": self.tombstones.layout},
+                tombstones=self.tombstones.to_payload(),
+                io_lock=self._tombstone_io_lock,
             )
             self._generation = gen
 
@@ -604,26 +1056,39 @@ class Index:
     @staticmethod
     def _commit_generation(storage_dir: str, gen: int, state: dict,
                            meta: list, buffer: list, cfg: IndexCfg,
-                           extra: Optional[dict] = None) -> None:
+                           extra: Optional[dict] = None,
+                           tombstones: Optional[dict] = None,
+                           io_lock=None) -> None:
         """ONE copy of the torn-snapshot commit protocol, shared by the
-        normal save path and the shard-transfer import: every file of
-        generation ``gen`` is written atomically (tmp+fsync+rename), and
-        the generation only becomes loadable when its MANIFEST — with
-        per-file sha256 — lands LAST. kill -9 at any byte offset leaves
-        either the previous committed generation intact or a complete
-        new one; load verifies checksums and quarantines anything in
-        between (supersedes the reference's acknowledged torn-write
-        TODO, index.py:443-446). Also refreshes the unversioned cfg.json
-        convenience copy (get_config_path readers expect the fixed name;
-        it is NOT part of the committed set) and prunes to the newest 2
-        generations."""
+        normal save path, compaction, and the shard-transfer import: every
+        file of generation ``gen`` is written atomically
+        (tmp+fsync+rename), and the generation only becomes loadable when
+        its MANIFEST — with per-file sha256 — lands LAST. kill -9 at any
+        byte offset leaves either the previous committed generation intact
+        or a complete new one; load verifies checksums and quarantines
+        anything in between (supersedes the reference's acknowledged
+        torn-write TODO, index.py:443-446). ``tombstones`` is the
+        mutation sidecar payload committed WITH the generation (so a
+        loaded generation always pairs with the tombstone set valid for
+        its positional layout); after the manifest lands, the standalone
+        ``tombstones.json`` is refreshed from the same payload — ordering
+        that keeps every crash point on a consistent (generation, sidecar)
+        pair (mutation/tombstones.py). Also refreshes the unversioned
+        cfg.json convenience copy (get_config_path readers expect the
+        fixed name; it is NOT part of the committed set) and prunes to the
+        newest 2 generations."""
         os.makedirs(storage_dir, exist_ok=True)
+        ts_payload = (tombstones if tombstones is not None
+                      else TombstoneSet().to_payload())
         plan = {
             "index": ("npz", "wb", lambda f: save_state(f, state)),
             "meta": ("pkl", "wb", lambda f: pickle.dump(meta, f)),
             "buffer": ("pkl", "wb", lambda f: pickle.dump(buffer, f)),
             "cfg": ("json", "w",
                     lambda f: f.write(cfg.to_json_string() + "\n")),
+            "tombstones": ("json", "w",
+                           lambda f: f.write(
+                               _tombstones.dump_payload(ts_payload) + "\n")),
         }
         entries = {}
         for key, (ext, mode, write_fn) in plan.items():
@@ -631,6 +1096,19 @@ class Index:
             digest = atomic_write(os.path.join(storage_dir, name), write_fn, mode)
             entries[key] = {"name": name, "sha256": digest}
         serialization.write_manifest(storage_dir, gen, entries, extra=extra)
+        # the standalone sidecar shares its fixed tmp path with the
+        # per-mutation writer (_write_tombstone_sidecar), which runs
+        # OUTSIDE the engine locks — instance callers pass their
+        # _tombstone_io_lock so the two can never interleave on the tmp
+        # file (a torn rename would read as garbage and drop every delete
+        # acked since the last committed generation). import_snapshot
+        # commits onto a fresh engine's dir with no concurrent writers
+        # and passes None.
+        if io_lock is not None:
+            with io_lock:
+                _tombstones.write_sidecar(storage_dir, ts_payload)
+        else:
+            _tombstones.write_sidecar(storage_dir, ts_payload)
         atomic_write(
             os.path.join(storage_dir, "cfg.json"),
             lambda f: f.write(cfg.to_json_string() + "\n"), "w",
@@ -662,6 +1140,9 @@ class Index:
                 "meta": self.id_to_metadata.tolist(),
                 "buffer": list(self.embeddings_buffer),
                 "cfg_json": self.cfg.to_json_string(),
+                # mutation state travels with the shard: a replica joined
+                # from this snapshot must not resurrect deleted rows
+                "tombstones": self.tombstones.to_payload(),
             }
 
     @classmethod
@@ -687,10 +1168,12 @@ class Index:
         meta = list(snapshot.get("meta") or [])
         buffer = [np.asarray(b, np.float32)
                   for b in (snapshot.get("buffer") or [])]
+        tomb = TombstoneSet.from_payload(snapshot.get("tombstones"))
         state = snapshot.get("state")
         if state is None:
             # nothing trained at the source: replay the raw buffer
             result = cls(cfg)
+            result.tombstones = tomb
             offset = 0
             for chunk in buffer:
                 n = chunk.shape[0]
@@ -704,13 +1187,15 @@ class Index:
                   disk_gens[0][0] if disk_gens else 0) + 1
         cls._commit_generation(
             storage_dir, gen, state, meta, buffer, cfg,
-            extra={"ntotal": int(tpu_index.ntotal), "transferred": True},
+            extra={"ntotal": int(tpu_index.ntotal), "transferred": True,
+                   "layout": tomb.layout},
+            tombstones=tomb.to_payload(),
         )
         logger.info(
             "imported transferred shard (%d vectors, %d buffered) into %s "
             "as generation %d", tpu_index.ntotal,
             sum(b.shape[0] for b in buffer), storage_dir, gen)
-        result = cls._restore(cfg, tpu_index, meta, buffer)
+        result = cls._restore(cfg, tpu_index, meta, buffer, tombstones=tomb)
         result._generation = gen
         result.index_saved_size = tpu_index.ntotal
         return result
@@ -736,6 +1221,7 @@ class Index:
             logger.warning("quarantined %d abandoned .tmp file(s): %s",
                            len(stale), stale)
         chosen = None
+        fallbacks = 0
         for gen, mpath in serialization.list_generations(index_storage_dir):
             try:
                 manifest = serialization.load_manifest(mpath)
@@ -751,6 +1237,7 @@ class Index:
                 "back to the previous generation", gen, index_storage_dir, reason,
             )
             serialization.quarantine_generation(index_storage_dir, gen, reason)
+            fallbacks += 1
 
         if chosen is None:
             return cls._from_legacy_layout(index_storage_dir, cfg, ignore_buffer)
@@ -778,8 +1265,25 @@ class Index:
                 buffer = pickle.load(f)
         if cfg is None:
             cfg = IndexCfg.from_json(gen_path("cfg"))
-        result = cls._restore(cfg, tpu_index, meta, buffer)
+        # tombstone recovery: the generation's OWN sidecar applies
+        # unconditionally (positions committed with the rows); the
+        # standalone sidecar merges positionally when its layout epoch
+        # matches, and BY ID otherwise — a crash that tears the
+        # generation a post-compaction delete was keyed to must still
+        # honor the delete on the fallback layout (mutation/tombstones.py)
+        tomb = TombstoneSet.from_payload(
+            _tombstones.load_generation_payload(index_storage_dir, manifest))
+        side = _tombstones.load_sidecar(index_storage_dir)
+        if side is not None:
+            if int(side.get("layout", 0)) == tomb.layout:
+                tomb.merge_payload(side)
+            else:
+                _apply_sidecar_by_id(tomb, side, meta,
+                                     cfg.custom_meta_id_idx,
+                                     index_storage_dir)
+        result = cls._restore(cfg, tpu_index, meta, buffer, tombstones=tomb)
         result._generation = gen
+        result._mutation_counters["load_fallbacks"] = fallbacks
         return result
 
     @classmethod
@@ -810,17 +1314,33 @@ class Index:
 
         if cfg is None:
             cfg = IndexCfg.from_json(cfg_file) if os.path.isfile(cfg_file) else IndexCfg()
-        return cls._restore(cfg, tpu_index, meta, buffer)
+        # pre-manifest checkpoints never compacted, so their layout epoch
+        # is 0: a standalone sidecar with layout 0 applies directly
+        tomb = None
+        side = _tombstones.load_sidecar(index_storage_dir)
+        if side is not None and int(side.get("layout", 0)) == 0:
+            tomb = TombstoneSet.from_payload(side)
+        return cls._restore(cfg, tpu_index, meta, buffer, tombstones=tomb)
 
     @classmethod
-    def _restore(cls, cfg: IndexCfg, tpu_index, meta: list, buffer: list) -> "Index":
+    def _restore(cls, cfg: IndexCfg, tpu_index, meta: list, buffer: list,
+                 tombstones: Optional[TombstoneSet] = None) -> "Index":
         """Shared restore tail: wire a loaded (index, meta, buffer) triple
         into a TRAINED engine, re-adding a consistent leftover buffer and
-        truncating metadata otherwise."""
+        truncating metadata otherwise. ``tombstones`` (the recovered set)
+        is installed and re-applied to the device BEFORE the buffer
+        replay kicks off, so a dead buffered row is masked the moment its
+        drain chunk lands — a restart never resurrects a deleted row."""
         result = cls(cfg)
         result.tpu_index = tpu_index
         result.state = IndexState.TRAINED
         result.upd_cfg(cfg)
+        if tombstones is not None:
+            result.tombstones = tombstones
+            dead_indexed = [p for p in tombstones.rows()
+                            if p < tpu_index.ntotal]
+            if dead_indexed:
+                tpu_index.remove_rows(np.asarray(dead_indexed, np.int64))
 
         buffer_size = sum(v.shape[0] for v in buffer)
         if len(meta) == tpu_index.ntotal + buffer_size:

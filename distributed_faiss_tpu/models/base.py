@@ -42,6 +42,13 @@ def _write_rows(data, block, start):
     return jax.lax.dynamic_update_slice(data, block, (start,) + (0,) * (data.ndim - 1))
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _mask_rows_false(live, idx):
+    """Scatter False into a (cap,) bool live mask at ``idx``; out-of-range
+    indices (the bucket padding sentinel == cap) are dropped."""
+    return live.at[idx].set(False, mode="drop")
+
+
 @jax.jit
 def row_norms_f32(rows):
     """Exact fp32 ``||row||^2`` over the minor axis.
@@ -75,6 +82,10 @@ class DeviceVectorStore:
         self.cap = 0
         self.ntotal = 0
         self.data = None  # jnp (cap, *row_shape)
+        # tombstone mask (mutation subsystem): (cap,) bool, False = deleted.
+        # None until the first deletion — the scan entries then trace the
+        # exact pre-mutation program (delete-nothing byte identity).
+        self.live = None
 
     def _ensure(self, needed_rows: int):
         # capacity covers ntotal + bucketed write length, so the clamped
@@ -89,7 +100,25 @@ class DeviceVectorStore:
         else:
             pad = [(0, newcap - self.cap)] + [(0, 0)] * len(self.row_shape)
             self.data = jnp.pad(self.data, pad)
+        if self.live is not None:
+            # new capacity rows are live until masked
+            self.live = jnp.pad(self.live, (0, newcap - self.cap),
+                                constant_values=True)
         self.cap = newcap
+
+    def mask_rows(self, rows: np.ndarray) -> None:
+        """Tombstone ``rows`` (global row ids): one bucketed device scatter
+        of False into the live mask. Idempotent; never shrinks ``ntotal``
+        (positions stay stable — the positional metadata contract)."""
+        rows = np.asarray(rows, np.int64)
+        if rows.size == 0:
+            return
+        if self.live is None:
+            self.live = jnp.ones((self.cap,), bool)
+        bucket = _next_pow2(rows.size, 1024)
+        idx = np.full(bucket, self.cap, np.int64)  # pad -> dropped (OOB)
+        idx[: rows.size] = rows
+        self.live = _mask_rows_false(self.live, jnp.asarray(idx))
 
     def add(self, rows: np.ndarray) -> Tuple[int, int]:
         """Append rows; returns the (start, end) id range they occupy."""
@@ -116,6 +145,16 @@ class DeviceVectorStore:
         if self.data is None:
             return np.zeros((0,) + self.row_shape, self.dtype)
         return np.asarray(self.data[: self.ntotal])
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _mask_cells_neg1(flat_ids, cells):
+    """Scatter -1 into a flattened (nlist*cap,) ids plane at ``cells``;
+    out-of-range cells (the bucket padding sentinel) are dropped. This IS
+    the IVF tombstone materialization: every scan entry — XLA, fused
+    pallas, mesh-masked, probe-routed — already ANDs ``ids >= 0`` with the
+    size mask, so a -1 cell is exactly a padding slot to all of them."""
+    return flat_ids.at[cells].set(-1, mode="drop")
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -230,6 +269,22 @@ class PaddedLists:
         variant overrides with strided ownership)."""
         return l
 
+    def mask_cells(self, cells: np.ndarray) -> None:
+        """Tombstone list cells (flat ``slot * cap + pos`` addresses): one
+        bucketed scatter of -1 into the ids plane. Sizes are NOT
+        decremented — a dead slot stays occupied (and masked) until
+        compaction rewrites the list, keeping every live (slot, pos)
+        address stable."""
+        cells = np.asarray(cells, np.int64)
+        if cells.size == 0:
+            return
+        bucket = _next_pow2(cells.size, 1024)
+        idx = np.full(bucket, self.nlist * self.cap, np.int64)  # pad: dropped
+        idx[: cells.size] = cells
+        flat = _mask_cells_neg1(self.ids.reshape(self.nlist * self.cap),
+                                jnp.asarray(idx))
+        self.ids = flat.reshape(self.nlist, self.cap)
+
     def append(self, list_idx: np.ndarray, payload: np.ndarray, gids: np.ndarray):
         """Append payload rows to their assigned lists.
 
@@ -309,6 +364,28 @@ class TpuIndex:
         """Return (approximate) stored vectors for ids (FAISS
         search_and_reconstruct parity, reference index.py:255-257)."""
         raise NotImplementedError
+
+    # --- mutation ---------------------------------------------------------
+    def supports_remove_rows(self) -> bool:
+        """True when this model carries a tombstone mask (overrides
+        ``remove_rows``). The engine checks this BEFORE recording any
+        tombstone — including for rows still in the add buffer, where the
+        mask would only be applied at drain time: accepting such a delete
+        and then having the drain thread hit the base-class rejection
+        would kill the worker and wedge the engine in ``ADD``."""
+        return type(self).remove_rows is not TpuIndex.remove_rows
+
+    def remove_rows(self, rows: np.ndarray) -> None:
+        """Tombstone rows (global sequential ids) out of every scan path:
+        a masked row can never surface in top-k, even when k exceeds the
+        live count. ``ntotal`` does NOT shrink — row ids stay stable (the
+        positional metadata contract); compaction (mutation/compaction.py)
+        is what reclaims the capacity. Idempotent. Subclasses that cannot
+        mask (graph indexes) keep this default and the engine surfaces the
+        limitation as an application error."""
+        raise RuntimeError(
+            f"{type(self).__name__} does not support remove/upsert "
+            "(no tombstone mask for this index kind)")
 
     # --- knobs ------------------------------------------------------------
     def set_nprobe(self, nprobe: int) -> None:

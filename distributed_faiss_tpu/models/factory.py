@@ -182,17 +182,25 @@ def _build_ivf_tpu(cfg: IndexCfg):
     mesh = _mesh(cfg)
     if cfg.extra.get("shard_lists"):
         # full multi-chip path: inverted lists partitioned across the mesh.
-        # The fused flat-scan kernel and bf16 scan are single-chip-only for
-        # now — say so instead of silently serving the masked XLA scan
-        for knob in ("pallas_flat", "scan_bf16", "refine_k_factor"):
-            if cfg.extra.get(knob):
-                logging.getLogger().warning(
-                    "%s is not wired for the sharded (shard_lists=True) "
-                    "flat scan yet; ignored — the masked/routed XLA scan "
-                    "serves this index unrefined", knob)
+        # scan_bf16 + refine_k_factor are wired (sharded raw-row refine,
+        # pre-merge exact rescore — parallel/mesh.py). The fused pallas
+        # flat-scan kernel remains single-chip-only: its scalar-prefetched
+        # gather indexes the global (nlist, cap) layout, which shard_map's
+        # per-chip list blocks cannot express — a documented limitation
+        # (docs/OPERATIONS.md#multi-chip-serving), logged only when the
+        # knob is explicitly set; the default config builds silently.
+        if cfg.extra.get("pallas_flat"):
+            logging.getLogger().warning(
+                "pallas_flat is a documented single-chip limitation for the "
+                "sharded (shard_lists=True) flat scan; serving the masked/"
+                "routed XLA scan (docs/OPERATIONS.md#multi-chip-serving)")
         return ShardedIVFFlatIndex(cfg.dim, _centroids(cfg), cfg.get_metric(),
                                    mesh=mesh, kmeans_iters=_kmeans_iters(cfg),
-                                   probe_routing=_probe_routing(cfg))
+                                   probe_routing=_probe_routing(cfg),
+                                   refine_k_factor=int(
+                                       cfg.extra.get("refine_k_factor", 0)),
+                                   scan_bf16=bool(
+                                       cfg.extra.get("scan_bf16", False)))
     if _probe_routing(cfg):
         logging.getLogger().warning(
             "probe_routing (cfg.extra or DFT_MESH_MODE=routed) requires "
@@ -377,6 +385,27 @@ def _build_hnsw_spec(M: int, dim: int, cfg: IndexCfg):
             refine_k_factor=int(cfg.extra.get("refine_k_factor", 8)),
         )
     return FlatIndex(dim, "l2", codec="sq8")
+
+
+def remove_rows_unsupported(cfg: IndexCfg) -> bool:
+    """True when ``cfg`` resolves to a model WITHOUT a tombstone mask (the
+    native HNSW graph — traversal cannot skip masked nodes without recall
+    loss). Checkable BEFORE the model instance exists, so
+    ``engine.Index.remove_ids`` can reject a delete up front while every
+    row still sits in the add buffer (``tpu_index`` is None at that
+    point); must mirror the build dispatch: without the C++ graph both
+    the ``hnswsq`` builder and ``HNSW<M>`` factory cores fall back to the
+    exact sq8 FlatIndex, which masks fine."""
+    from distributed_faiss_tpu.models import hnsw
+
+    if cfg.index_builder_type == "hnswsq":
+        return hnsw.native_available()
+    spec = cfg.faiss_factory or ""
+    if "{centroids}" in spec:
+        spec = spec.format(centroids=int(cfg.centroids or 0))
+    if any(_HNSW_RE.match(p.strip()) for p in spec.split(",")):
+        return hnsw.native_available()
+    return False
 
 
 def build_index(cfg: IndexCfg):

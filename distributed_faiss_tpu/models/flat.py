@@ -35,14 +35,16 @@ _CODEC_DTYPES = {
 
 @functools.partial(jax.jit, static_argnames=("k", "metric", "codec"))
 def _flat_search_fused(q3, data, ntotal, k: int, metric: str, codec: str,
-                       vmin=None, span=None):
+                       vmin=None, span=None, live=None):
     """Whole multi-block exact scan in ONE device launch (lax.map over
     (nblocks, block, d) stacked queries — launch-bound serving, see
-    base.pick_query_block)."""
+    base.pick_query_block). ``live`` is the optional (cap,) tombstone mask
+    (mutation subsystem), AND-ed with the ntotal padding mask in the scan."""
 
     def body(qb):
         kwargs = {} if codec != "sq8" else {"codec": "sq8", "vmin": vmin, "span": span}
-        return distance.knn(qb, data, k, metric=metric, ntotal=ntotal, **kwargs)
+        return distance.knn(qb, data, k, metric=metric, ntotal=ntotal,
+                            live=live, **kwargs)
 
     return jax.lax.map(body, q3)
 
@@ -80,6 +82,9 @@ class FlatIndex(base.TpuIndex):
             rows = x
         self.store.add(rows)
 
+    def remove_rows(self, rows: np.ndarray) -> None:
+        self.store.mask_rows(rows)
+
     def search(self, q: np.ndarray, k: int):
         nq = q.shape[0]
         if self.ntotal == 0:
@@ -106,6 +111,7 @@ class FlatIndex(base.TpuIndex):
                 jnp.asarray(self.store.ntotal, jnp.int32), k=k,
                 metric=self.metric, codec=self.codec,
                 vmin=kwargs.get("vmin"), span=kwargs.get("span"),
+                live=self.store.live,
             )
             out_s = np.asarray(vals).reshape(nblocks * nb, -1)[:nq]
             out_i = np.asarray(ids).reshape(nblocks * nb, -1)[:nq].astype(np.int64)
@@ -114,7 +120,8 @@ class FlatIndex(base.TpuIndex):
         out_i = np.empty((nq, k), np.int64)
         for s, n, block in base.query_blocks(q, nb):
             vals, ids = distance.knn(
-                block, self.store.data, k, metric=self.metric, ntotal=self.store.ntotal, **kwargs
+                block, self.store.data, k, metric=self.metric,
+                ntotal=self.store.ntotal, live=self.store.live, **kwargs
             )
             out_s[s : s + n] = np.asarray(vals)[:n]
             out_i[s : s + n] = np.asarray(ids)[:n]

@@ -385,6 +385,22 @@ class _IVFBase(base.TpuIndex):
             self._host_pos = [np.concatenate(self._host_pos)]
         return self._host_pos[0] if self._host_pos else np.zeros((0,), np.int32)
 
+    def remove_rows(self, rows: np.ndarray) -> None:
+        """Tombstone rows out of the inverted lists: scatter -1 into the
+        device ids plane at the rows' (slot, pos) cells. Every scan entry —
+        the XLA probe scan, the fused pallas flat/ADC kernels, and the
+        mesh-sharded masked/routed programs — already ANDs ``ids >= 0``
+        with the size mask, so a tombstoned cell is indistinguishable from
+        padding to all of them, and the delete-nothing case (no scatter)
+        stays byte-identical to the pre-mutation program."""
+        rows = np.asarray(rows, np.int64)
+        if rows.size == 0 or self.lists is None:
+            return
+        assign = self._host_assign_array()[rows].astype(np.int64)
+        pos = self._host_pos_array()[rows].astype(np.int64)
+        cells = np.asarray(self.lists.slot_of(assign)) * self.lists.cap + pos
+        self.lists.mask_cells(cells)
+
     def _device_rows(self, ids: np.ndarray) -> np.ndarray:
         """Stored payload rows (encoded) for global ids, gathered from the
         device lists — one bucketed launch, no host corpus mirror."""

@@ -1,0 +1,47 @@
+"""Mutable corpora: id-keyed tombstone deletes, upsert, and background
+compaction (ROADMAP item 3 — the subsystem the reference never had; its
+only mutation primitive is whole-index ``drop_index``).
+
+Layering:
+
+- device side: every index model exposes ``remove_rows`` (models/base.py).
+  Flat corpora materialize the tombstone set as a device-resident boolean
+  ``live`` mask AND-ed with the ntotal padding mask inside the scan
+  (ops/distance.py ``_knn_scan``); IVF families materialize it into the
+  device ids plane — a tombstoned cell's id becomes -1, which the
+  ``ids >= 0`` AND every scan entry (XLA, fused pallas, mesh-masked,
+  probe-routed) already applies treats exactly like padding. Either way
+  the device-side cost of mutability is one more mask AND, and the
+  delete-nothing case traces the exact pre-mutation program (byte
+  identity).
+- engine side (engine.py): ``Index.remove_ids`` / ``Index.upsert`` map
+  user metadata ids onto positional rows (buffer-aware — an id still in
+  the add buffer is masked when its rows drain), record them in a
+  :class:`tombstones.TombstoneSet`, and persist the set crash-safely —
+  both as a sidecar file inside every MANIFEST generation and as a
+  standalone ``tombstones.json`` rewritten atomically on every mutation,
+  stamped with a layout epoch so a crash-fallback to an older generation
+  can never resurrect a deleted row (see tombstones.py).
+- background compaction (compaction.py): a named watcher thread per
+  engine rewrites tombstoned rows out of the index into a fresh
+  generation (committed via the shared ``_commit_generation`` protocol)
+  once the tombstone fraction crosses ``DFT_COMPACT_THRESHOLD``, swapped
+  in atomically under the index lock; SIGKILL at any point falls back to
+  the previous complete generation with tombstones intact.
+- distributed layer (parallel/client.py, parallel/server.py):
+  ``remove_ids``/``upsert`` fan out per replica group under the quorum
+  machinery; below-quorum deletes land in the repair queue (never
+  rerouted cross-group), and ``get_perf_stats`` grows a ``mutation`` key.
+"""
+
+from distributed_faiss_tpu.mutation.tombstones import (  # noqa: F401
+    SIDECAR_NAME,
+    TombstoneSet,
+    load_sidecar,
+    write_sidecar,
+)
+from distributed_faiss_tpu.mutation.compaction import (  # noqa: F401
+    CompactionUnsupported,
+    compact_state,
+    run_watcher,
+)

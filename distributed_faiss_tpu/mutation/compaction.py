@@ -1,0 +1,97 @@
+"""Background compaction: rewrite tombstoned rows out of an index.
+
+Masked rows cost capacity (dead list cells, dead corpus rows) and scan
+FLOPs until something reclaims them. Compaction is that something: it
+filters the index's own ``state_dict`` down to the surviving rows and
+rebuilds through ``index_from_state_dict`` — so centroids, codebooks and
+quantizer params are preserved bit-for-bit, encoded payloads are copied
+verbatim (no decode/re-encode drift), and the rebuilt inverted lists
+come back TIGHT: tombstoned cells are gone and list capacity re-sizes to
+the surviving fill (the rebuild's power-of-two growth is what also
+splits any list that had outgrown its padded capacity). The engine's
+three-phase ``Index.compact`` drives it: snapshot under the locks,
+rebuild with serving live, then catch-up + MANIFEST commit + atomic swap
+back under the locks (see engine.py for the crash-window analysis).
+
+``run_watcher`` is the per-engine background driver — a named daemon
+thread (like the save watcher) that wakes every ``DFT_COMPACT_INTERVAL``
+seconds and triggers ``Index.compact`` once the indexed tombstone
+fraction crosses ``DFT_COMPACT_THRESHOLD``. It rides the engine's
+``_retired`` event, so retiring an engine (drop, shard-transfer
+replacement) wakes and exits the watcher immediately.
+"""
+
+import logging
+import os
+import time
+
+import numpy as np
+
+logger = logging.getLogger()
+
+# state-dict kinds compact_state knows how to filter, and the per-row
+# arrays (insertion order) each carries. Graph/pretransform kinds are not
+# maskable/filterable yet — the engine surfaces that as a no-op with a log.
+_ROW_KEYS = ("rows", "assign", "list_norms", "refine_rows")
+SUPPORTED_KINDS = frozenset({
+    "flat", "sharded_flat", "ivf_flat", "sharded_ivf_flat",
+    "ivf_pq", "sharded_ivf_pq",
+})
+
+
+class CompactionUnsupported(RuntimeError):
+    """This index kind has no row-filterable state dict."""
+
+
+def compact_state(state: dict, keep: np.ndarray) -> dict:
+    """Filter a model ``state_dict`` down to the rows where ``keep`` is
+    True (insertion order). Returns a NEW state dict of the same kind;
+    structural fields (centroids, codebooks, sq params, knobs) are
+    shared, per-row arrays are filtered."""
+    kind = str(state["kind"])
+    if kind not in SUPPORTED_KINDS:
+        raise CompactionUnsupported(
+            f"index kind {kind!r} has no row-filterable state")
+    keep = np.asarray(keep, bool)
+    out = dict(state)
+    if kind == "flat":
+        data = np.asarray(state["data"])
+        if keep.shape[0] != data.shape[0]:
+            raise ValueError(
+                f"keep mask covers {keep.shape[0]} rows, state has "
+                f"{data.shape[0]}")
+        out["data"] = data[keep]
+        out["ntotal"] = int(keep.sum())
+        return out
+    for key in _ROW_KEYS:
+        if key in state:
+            arr = np.asarray(state[key])
+            if arr.shape[0] != keep.shape[0]:
+                raise ValueError(
+                    f"keep mask covers {keep.shape[0]} rows, state[{key!r}] "
+                    f"has {arr.shape[0]}")
+            out[key] = arr[keep]
+    return out
+
+
+def run_watcher(engine, cfg) -> None:
+    """Body of the per-engine compaction watcher thread.
+
+    ``engine`` is an ``engine.Index``; ``cfg`` a ``MutationCfg``. The
+    retired event doubles as the sleep (save-watcher precedent): retire()
+    wakes the watcher immediately instead of leaking it one interval."""
+    name = os.path.basename(engine.cfg.index_storage_dir or "?")
+    while not engine._retired.wait(cfg.interval_s):
+        try:
+            frac = engine.tombstone_fraction()
+            if frac < cfg.threshold:
+                continue
+            logger.info(
+                "compaction watcher (%s): tombstone fraction %.3f >= %.3f, "
+                "compacting", name, frac, cfg.threshold)
+            engine.compact()
+        except Exception:
+            # the watcher must survive any single failed pass — the next
+            # interval retries against fresh state
+            logger.exception("compaction pass failed (%s)", name)
+            time.sleep(min(1.0, cfg.interval_s))

@@ -1,0 +1,173 @@
+"""Tombstone bookkeeping + the crash-safe sidecar protocol.
+
+A :class:`TombstoneSet` is the engine-side record of deleted rows for ONE
+shard: a ``row -> user id`` map (rows are the positional ids the
+metadata join is keyed on — stable under the append-only contract) plus
+a **layout epoch**. The set itself is plain data; the OWNING engine
+guards it under ``index_lock`` (graftlint lock-discipline PIN), which is
+also what makes a scheduler-coalesced device window see one consistent
+tombstone snapshot — the mask scatter and the device launch serialize on
+the same lock, so a merged batch is entirely pre-delete or entirely
+post-delete, never torn.
+
+Durability (the "a crash never resurrects deleted rows" contract):
+
+- every committed MANIFEST generation carries a ``tombstones-gNNN.json``
+  sidecar entry (sha256-verified like every other generation file) with
+  the set AND the layout epoch the positions are valid for;
+- additionally, every mutation rewrites the standalone, unversioned
+  ``tombstones.json`` via tmp+fsync+rename — the delete is durable the
+  moment ``remove_ids`` returns, without paying a full snapshot commit.
+
+The layout epoch resolves the one hazard of positional tombstones:
+compaction renumbers rows. A load applies the generation's own sidecar
+unconditionally (positions and payload were committed together), and
+merges the standalone sidecar ONLY when its layout matches — a stale
+sidecar from a rolled-back (or newer, crashed-before-swap) layout is
+ignored rather than misapplied. Because compaction commits its catch-up
+tombstones inside the new generation's own sidecar *before* rewriting
+the standalone file (all under the engine locks), every crash point
+lands on a consistent (generation, sidecar) pair.
+"""
+
+import json
+import os
+from typing import Dict, Iterable, Optional
+
+from distributed_faiss_tpu.utils import serialization
+
+SIDECAR_NAME = "tombstones.json"
+
+PAYLOAD_FORMAT = 1
+
+
+class TombstoneSet:
+    """Positional dead-row set with the id-keyed record riding along.
+
+    Plain data — thread-safety is the owning engine's ``index_lock``
+    (copy what you need under the lock before iterating outside it).
+    """
+
+    __slots__ = ("_rows", "layout")
+
+    def __init__(self, rows: Optional[Dict[int, object]] = None,
+                 layout: int = 0):
+        self._rows: Dict[int, object] = (
+            {int(r): v for r, v in rows.items()} if rows else {})
+        self.layout = int(layout)
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def __contains__(self, row: int) -> bool:
+        return int(row) in self._rows
+
+    def rows(self) -> list:
+        """Dead row positions (copy — safe to use outside the lock)."""
+        return list(self._rows)
+
+    def ids(self) -> list:
+        """User ids of the dead rows (copy; informational — positions are
+        the authoritative recovery key)."""
+        return list(self._rows.values())
+
+    def items(self) -> list:
+        """(row, user id) pairs (copy — safe outside the lock)."""
+        return list(self._rows.items())
+
+    def add(self, rows: Iterable[int], ids: Optional[Iterable] = None) -> None:
+        if ids is None:
+            for r in rows:
+                self._rows.setdefault(int(r), None)
+            return
+        for r, i in zip(rows, ids):
+            self._rows[int(r)] = i
+
+    def count_below(self, n: int) -> int:
+        """Dead rows with position < n (i.e. already indexed rows)."""
+        return sum(1 for r in self._rows if r < n)
+
+    def rows_in_range(self, lo: int, hi: int) -> list:
+        """Dead positions in [lo, hi) — the buffer-drain mask window."""
+        return [r for r in self._rows if lo <= r < hi]
+
+    def to_payload(self) -> dict:
+        rows = sorted(self._rows)
+        return {
+            "format": PAYLOAD_FORMAT,
+            "layout": self.layout,
+            "dead_rows": rows,
+            "dead_ids": [self._rows[r] for r in rows],
+        }
+
+    @classmethod
+    def from_payload(cls, payload: Optional[dict]) -> "TombstoneSet":
+        if not payload:
+            return cls()
+        rows = [int(r) for r in payload.get("dead_rows", ())]
+        ids = list(payload.get("dead_ids", ()))
+        mapping = dict.fromkeys(rows)
+        for r, i in zip(rows, ids):
+            mapping[r] = i
+        return cls(mapping, layout=int(payload.get("layout", 0)))
+
+    def merge_payload(self, payload: Optional[dict]) -> None:
+        """Union another payload's rows in (same-layout sidecar merge)."""
+        if not payload:
+            return
+        other = TombstoneSet.from_payload(payload)
+        for r, i in other._rows.items():
+            self._rows.setdefault(r, i)
+
+    def __repr__(self) -> str:
+        return f"<TombstoneSet {len(self._rows)} dead, layout {self.layout}>"
+
+
+def dump_payload(payload: dict) -> str:
+    """JSON text for a tombstone payload. ``default=str`` keeps arbitrary
+    metadata id objects from failing the dump — the stringified form is
+    informational; the integer positions are the recovery key."""
+    return json.dumps(payload, default=str, sort_keys=True)
+
+
+def write_sidecar(storage_dir: str, payload: dict) -> None:
+    """Atomically (tmp+fsync+rename) rewrite the standalone sidecar — the
+    per-mutation durability point."""
+    serialization.atomic_write(
+        os.path.join(storage_dir, SIDECAR_NAME),
+        lambda f: f.write(dump_payload(payload) + "\n"), "w",
+    )
+
+
+def load_sidecar(storage_dir: str) -> Optional[dict]:
+    path = os.path.join(storage_dir, SIDECAR_NAME)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        # a torn sidecar is impossible via atomic_write; treat garbage as
+        # absent but loudly (the generation sidecar still covers recovery
+        # up to the last commit)
+        import logging
+
+        logging.getLogger().warning(
+            "unreadable tombstone sidecar at %s: %s", path, e)
+        return None
+
+
+def load_generation_payload(storage_dir: str, manifest: dict) -> Optional[dict]:
+    """The committed generation's own tombstone entry (None for
+    pre-mutation generations)."""
+    entry = manifest.get("files", {}).get("tombstones")
+    if not entry:
+        return None
+    try:
+        with open(os.path.join(storage_dir, entry["name"])) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        # verify_manifest already sha256-checked the file; reaching here
+        # means filesystem-level corruption after the check — degrade to
+        # the standalone sidecar
+        return None

@@ -137,7 +137,7 @@ def segmented_topk_rows(s, k: int, ids):
 
 @functools.partial(jax.jit, static_argnames=("k", "metric", "chunk", "codec"))
 def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int, codec: str = "raw",
-              vmin=None, span=None):
+              vmin=None, span=None, live=None):
     """Chunked corpus scan with running top-k.
 
     q: (nq, d) fp32; x: (cap, d) with cap % chunk == 0; ntotal: traced scalar —
@@ -145,6 +145,11 @@ def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int, codec: str = "raw",
     codec: 'raw' (any float dtype, cast to fp32) or 'sq8' (uint8 codes
     dequantized on the fly with per-dim vmin/span — the decode fuses into the
     matmul's operand load, so SQ8 storage costs bandwidth, not FLOPs).
+    live: optional (cap,) bool — the tombstone mask (mutation subsystem):
+    False rows are masked to -inf exactly like capacity padding, so a
+    deleted row can never surface even when k exceeds the live count. None
+    (no deletions) traces the exact pre-mutation program — the
+    delete-nothing byte-identity gate.
     Returns (scores (nq, k), ids (nq, k) int32) sorted descending by score.
     """
     nq = q.shape[0]
@@ -154,6 +159,7 @@ def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int, codec: str = "raw",
     qn = jnp.sum(q * q, axis=1, keepdims=True)
 
     x_chunks = x.reshape(nchunks, chunk, x.shape[1])
+    live_chunks = None if live is None else live.reshape(nchunks, chunk)
 
     # the never-taken select keeps a structural data dependency on x so the
     # carry's device-varying annotation stays consistent when this scan runs
@@ -167,7 +173,11 @@ def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int, codec: str = "raw",
     )
 
     def body(carry, inp):
-        ci, xc = inp
+        if live_chunks is None:
+            ci, xc = inp
+            lc = None
+        else:
+            ci, xc, lc = inp
         best_v, best_i = carry
         xc = xc.astype(jnp.float32)
         if codec == "sq8":
@@ -180,23 +190,29 @@ def _knn_scan(q, x, ntotal, k: int, metric: str, chunk: int, codec: str = "raw",
             s = -(qn - 2.0 * ip + xn[None, :])
         base = ci * chunk
         gids = base + jnp.arange(chunk, dtype=jnp.int32)
-        s = jnp.where(gids[None, :] < ntotal, s, NEG_INF)
+        ok = gids[None, :] < ntotal
+        if lc is not None:
+            ok = ok & lc[None, :]
+        s = jnp.where(ok, s, NEG_INF)
         cv, cids = segmented_topk(s, min(k, chunk), gids)
         return merge_topk(best_v, best_i, cv, cids, k), None
 
-    (vals, ids), _ = jax.lax.scan(
-        body, init, (jnp.arange(nchunks, dtype=jnp.int32), x_chunks)
-    )
+    xs = (jnp.arange(nchunks, dtype=jnp.int32), x_chunks)
+    if live_chunks is not None:
+        xs = xs + (live_chunks,)
+    (vals, ids), _ = jax.lax.scan(body, init, xs)
     return vals, ids
 
 
 def knn(q, x, k: int, metric: str = "l2", ntotal=None, chunk: int = 65536,
-        codec: str = "raw", vmin=None, span=None):
+        codec: str = "raw", vmin=None, span=None, live=None):
     """Exact k-nearest-neighbor scan of a (possibly capacity-padded) corpus.
 
     Returns bigger-is-better (scores, ids). ``ntotal`` masks padding rows;
     defaults to the full array. ``chunk`` bounds the transient score block
-    (nq x chunk fp32 in VMEM-friendly tiles).
+    (nq x chunk fp32 in VMEM-friendly tiles). ``live`` is the optional
+    (cap,) bool tombstone mask (False = deleted, masked like padding);
+    None runs the exact pre-mutation program.
     """
     cap = x.shape[0]
     if ntotal is None:
@@ -207,8 +223,10 @@ def knn(q, x, k: int, metric: str = "l2", ntotal=None, chunk: int = 65536,
         # chunk-aligned so this path is cold.
         newcap = ((cap + chunk - 1) // chunk) * chunk
         x = jnp.pad(x, ((0, newcap - cap), (0, 0)))
+        if live is not None:
+            live = jnp.pad(live, (0, newcap - cap))
     # maybe_checked: GRAFT_SANITIZE=1 runs the scan under checkify
     # (NaN + OOB-gather checks); identity passthrough otherwise
     return sanitize.maybe_checked(
         _knn_scan, q, x, jnp.asarray(ntotal, jnp.int32), k=k, metric=metric,
-        chunk=chunk, codec=codec, vmin=vmin, span=span)
+        chunk=chunk, codec=codec, vmin=vmin, span=span, live=live)

@@ -565,6 +565,15 @@ class IndexClient:
                                  embeddings, metadata) -> List[dict]:
         """Log replicas that missed a write into the bounded repair queue
         (one record per batch, carrying the payload for the re-send)."""
+        return self._record_repair_op(
+            index_id, gid, failed, op="add",
+            embeddings=embeddings, metadata=metadata)
+
+    def _record_repair_op(self, index_id: str, gid: int, failed,
+                          op: str, **payload) -> List[dict]:
+        """Shared repair-record writer: one entry per (batch, op) carrying
+        everything the re-send needs. ``op`` is "add" (embeddings +
+        metadata payload) or "remove_ids" (ids payload)."""
         records = [{
             "skipped_server": self.sub_indexes[pos].id,
             "host": self.sub_indexes[pos].host,
@@ -572,33 +581,44 @@ class IndexClient:
             "error": f"{type(e).__name__}: {e}",
         } for pos, e in failed]
         self.repair_queue.record({
+            "op": op,
             "index_id": index_id,
             "group": gid,
             "missing": [pos for pos, _e in failed],
-            "embeddings": embeddings,
-            "metadata": metadata,
             "failures": records,
+            **payload,
         })
         with self._stats_lock:
             self.counters["under_replicated"] += 1
         return records
 
+    def _repair_send(self, item: dict, pos: int) -> None:
+        """One repair re-send, dispatched by the record's op."""
+        if item.get("op", "add") == "remove_ids":
+            self._call_with_retry(
+                self.sub_indexes[pos], "remove_ids",
+                (item["index_id"], item["ids"]))
+        else:
+            self._call_with_retry(
+                self.sub_indexes[pos], "add_index_data",
+                (item["index_id"], item["embeddings"],
+                 item["metadata"], True))
+
     def repair_under_replicated(self) -> dict:
         """Background repair: re-send every recorded under-replicated
-        batch to the replicas that missed it. Batches whose replicas are
-        still unreachable go back on the (bounded) queue. Returns
-        ``{"repaired": n, "still_pending": m}``. Idempotence rides the
-        write path's at-least-once contract: unique metadata ids make a
-        double-applied repair detectable downstream."""
+        batch — adds AND deletes (op field) — to the replicas that missed
+        it. Batches whose replicas are still unreachable go back on the
+        (bounded) queue. Returns ``{"repaired": n, "still_pending": m}``.
+        Idempotence: deletes are naturally idempotent (re-masking a dead
+        row is a no-op); adds ride the write path's at-least-once
+        contract — unique metadata ids make a double-applied repair
+        detectable downstream."""
         repaired = still_pending = 0
         for item in self.repair_queue.drain():
             missing = []
             for pos in item["missing"]:
                 try:
-                    self._call_with_retry(
-                        self.sub_indexes[pos], "add_index_data",
-                        (item["index_id"], item["embeddings"],
-                         item["metadata"], True))
+                    self._repair_send(item, pos)
                 except Exception as e:
                     logger.warning("repair of %s group %s on rank %s still "
                                    "failing: %s", item["index_id"],
@@ -612,6 +632,116 @@ class IndexClient:
                 self.repair_queue.mark_repaired()
                 repaired += 1
         return {"repaired": repaired, "still_pending": still_pending}
+
+    # ------------------------------------------------------------- mutation
+
+    def remove_ids(self, index_id: str, ids) -> int:
+        """Cluster-wide delete by metadata id (mutation subsystem).
+
+        Round-robin placement spreads an id's rows over any group, so the
+        delete fans out to EVERY replica of EVERY group and acks per group
+        at the write quorum (clamped to the group's registered size, like
+        add_index_data). Replicas that miss an acked delete are recorded
+        in the repair queue as an ``op="remove_ids"`` record
+        (``repair_under_replicated`` re-sends it — deletes are idempotent,
+        so the at-least-once repair is exact). A group below quorum is
+        NEVER rerouted cross-group — no other group holds that group's
+        rows, so rerouting could only delete the wrong shard's data —
+        instead the partial placement is recorded for repair and, after
+        every group has been attempted, a ``QuorumError`` raises (the
+        delete is durably applied wherever it acked; ids are safe to
+        retry). Returns the max per-group tombstoned-row count summed
+        over groups (replicas of a group converge on the same rows).
+
+        An application error from a live replica (index missing, an index
+        kind without tombstone support) propagates immediately — it would
+        repeat identically everywhere.
+        """
+        ids = list(ids)
+        if not ids:
+            return 0
+        groups = sorted(self.membership.snapshot().items())
+        if not groups:
+            raise RuntimeError("no replica groups registered")
+
+        def one(pos):
+            try:
+                return pos, self._call_with_retry(
+                    self.sub_indexes[pos], "remove_ids", (index_id, ids))
+            except rpc.TRANSPORT_ERRORS as e:
+                return pos, e
+
+        removed = 0
+        quorum_failure = None
+        for gid, reps in groups:
+            needed = min(self.quorum, len(reps))
+            results = list(self.pool.map(one, reps))
+            acked = [(p, r) for p, r in results
+                     if not isinstance(r, BaseException)]
+            failed = [(p, e) for p, e in results
+                      if isinstance(e, BaseException)]
+            if acked:
+                removed += max(int(r) for _p, r in acked)
+            if len(acked) >= needed:
+                if failed:
+                    # durable at quorum; the missed replicas go to repair
+                    self._record_repair_op(index_id, gid, failed,
+                                           op="remove_ids", ids=ids)
+                continue
+            # below quorum: record for repair, never reroute cross-group;
+            # keep attempting the remaining groups (their rows must still
+            # be deleted) and raise the structured failure at the end
+            records = self._record_repair_op(index_id, gid, failed,
+                                             op="remove_ids", ids=ids)
+            with self._stats_lock:
+                self.counters["quorum_failures"] += 1
+            if quorum_failure is None:
+                quorum_failure = QuorumError(
+                    index_id, gid, [p for p, _r in acked], needed, records)
+        if quorum_failure is not None:
+            raise quorum_failure
+        return removed
+
+    def upsert(self, index_id: str, ids, embeddings: np.ndarray,
+               metadata: Optional[List[object]] = None) -> int:
+        """Cluster-wide delete + add: tombstone every live row carrying
+        ``ids`` (all groups, quorum semantics of ``remove_ids``), then
+        place the replacement batch through the normal quorum write path.
+        Old and new rows are never both live; the new rows become
+        searchable when their buffer chunk drains on the placed group.
+        Returns the rows tombstoned."""
+        ids = list(ids)
+        embeddings = np.asarray(embeddings, np.float32)
+        if embeddings.shape[0] != len(ids):
+            raise RuntimeError(
+                "upsert ids length should match the batch size of the "
+                "embeddings")
+        if metadata is None:
+            if self.cfg is None:
+                # without a cfg the client cannot know where the id rides
+                # in the metadata tuple; synthesizing (id,) against an
+                # index with custom_meta_id_idx != 0 would insert rows
+                # whose id lives in the wrong slot — rows no later
+                # remove_ids/upsert could ever match (the engine raises in
+                # the equivalent unknown-layout case)
+                raise RuntimeError(
+                    "upsert without explicit metadata needs the client "
+                    "cfg (cfg_path) to know custom_meta_id_idx — pass "
+                    "metadata")
+            if self.cfg.custom_meta_id_idx != 0:
+                raise RuntimeError(
+                    "upsert needs explicit metadata when "
+                    "custom_meta_id_idx != 0")
+            metadata = [(i,) for i in ids]
+        removed = self.remove_ids(index_id, ids)
+        self.add_index_data(index_id, embeddings, metadata)
+        return removed
+
+    def compact_index(self, index_id: str) -> list:
+        """Trigger a compaction pass on every rank (the per-rank watcher
+        normally drives this; the broadcast is the operator/runbook
+        hook). Returns the per-rank booleans in stub order."""
+        return self._broadcast("compact_index", (index_id,))
 
     def sync_train(self, index_id: str) -> None:
         self._broadcast("sync_train", (index_id,))
@@ -729,6 +859,21 @@ class IndexClient:
                             idx.id, idx.host, idx.port, group, e)
                         last = e
                         continue
+                    except rpc.ServerException as e:
+                        # ONE application error is failover-eligible: the
+                        # engine's transient mid-ADD (buffer drain)
+                        # rejection — the group keeps serving from a peer
+                        # while a replica drains. Every other application
+                        # error (and a whole group mid-drain) still raises.
+                        if (replication.drain_failover_eligible(e)
+                                and i + 1 < len(ordering)):
+                            logger.info(
+                                "replica %s of group %s is draining its add "
+                                "buffer; failing search over to a peer",
+                                idx.id, group)
+                            last = e
+                            continue
+                        raise
                     if i > 0:
                         note_failover(group, pos)
                     return out
@@ -767,6 +912,16 @@ class IndexClient:
                         idx.id, idx.host, idx.port, group, e)
                     fails.append(_FailedRank(idx, e))
                     continue
+                except rpc.ServerException as e:
+                    # mid-ADD drain rejection: group-failover-eligible
+                    # (see one_strict); a whole group mid-drain — or any
+                    # other application error — still raises rather than
+                    # silently dropping a healthy shard's corpus
+                    if (replication.drain_failover_eligible(e)
+                            and i + 1 < len(ordering)):
+                        fails.append(_FailedRank(idx, e))
+                        continue
+                    raise
                 if i > 0:
                     note_failover(group, pos)
                 return out

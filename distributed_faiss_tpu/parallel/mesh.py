@@ -114,17 +114,21 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 
 def local_scan_merge(q_local, x_local, ntot_local, k: int, metric: str,
-                     chunk: int, axis: str = AXIS):
+                     chunk: int, axis: str = AXIS, live_local=None):
     """Per-chip exact scan + ICI all_gather candidate merge.
 
     The body of every sharded search: scan the local corpus block with the
     chunked running-top-k kernel, offset local ids to global (contiguous
     block layout: global id = shard * cap_local + pos), all_gather the
     (nq, k) candidates over ``axis`` and merge. Used by _sharded_knn_jit and
-    the dryrun's 2D (dp, shard) variant."""
+    the dryrun's 2D (dp, shard) variant. ``live_local`` is this chip's
+    slice of the tombstone mask (mutation subsystem), AND-ed with the
+    fill-count padding mask inside the scan; None (no deletions) traces
+    the exact pre-mutation program."""
     cap_local = x_local.shape[0]
     vals, ids = distance._knn_scan(
-        q_local, x_local, ntot_local, k, metric, min(chunk, cap_local)
+        q_local, x_local, ntot_local, k, metric, min(chunk, cap_local),
+        live=live_local,
     )
     base_id = jax.lax.axis_index(axis).astype(jnp.int32) * cap_local
     gids = jnp.where(ids >= 0, ids + base_id, ids)
@@ -140,15 +144,29 @@ def local_scan_merge(q_local, x_local, ntot_local, k: int, metric: str,
 @functools.partial(
     jax.jit, static_argnames=("mesh", "k", "metric", "chunk")
 )
-def _sharded_knn_jit(q, x, ntotals, mesh, k: int, metric: str, chunk: int):
-    """q replicated, x sharded (S*cap_local, d) along rows, ntotals (S,)."""
-
-    def local(q, x_local, ntot_local):
-        return local_scan_merge(q, x_local, ntot_local[0], k, metric, chunk)
+def _sharded_knn_jit(q, x, ntotals, mesh, k: int, metric: str, chunk: int,
+                     live=None):
+    """q replicated, x sharded (S*cap_local, d) along rows, ntotals (S,).
+    ``live``: optional row-sharded (S*cap_local,) bool tombstone mask."""
 
     # check_vma=False: the outputs ARE replicated (deterministic merge of
     # all_gather'ed candidates) but the static checker can't infer it
     # through the integer id path
+    if live is not None:
+        fn = _shard_map_fn(
+            lambda q, x_local, ntot_local, live_local: local_scan_merge(
+                q, x_local, ntot_local[0], k, metric, chunk,
+                live_local=live_local),
+            mesh=mesh,
+            in_specs=(P(), P(AXIS, None), P(AXIS), P(AXIS)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return fn(q, x, ntotals, live)
+
+    def local(q, x_local, ntot_local):
+        return local_scan_merge(q, x_local, ntot_local[0], k, metric, chunk)
+
     fn = _shard_map_fn(
         local,
         mesh=mesh,
@@ -180,14 +198,16 @@ def sharded_knn(mesh: Mesh, q, x, ntotals, k: int, metric: str = "l2",
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "metric", "chunk"))
-def _sharded_knn_fused(q3, x, ntotals, mesh, k: int, metric: str, chunk: int):
+def _sharded_knn_fused(q3, x, ntotals, mesh, k: int, metric: str, chunk: int,
+                       live=None):
     """Multi-block sharded exact search in ONE launch: lax.map over stacked
     (nblocks, block, d) query blocks, shard_map per block inside — the flat
     analog of _sharded_ivf_flat_search_fused, so a merged serving window
     never pays one dispatch (or one host round-trip) per block."""
 
     def body(qb):
-        return _sharded_knn_jit(qb, x, ntotals, mesh, k, metric, chunk)
+        return _sharded_knn_jit(qb, x, ntotals, mesh, k, metric, chunk,
+                                live=live)
 
     return jax.lax.map(body, q3)
 
@@ -325,12 +345,25 @@ class ShardedFlatIndex(base.TpuIndex):
         self._cap_local = 0
         self._synced_n = 0     # rows already written to the device corpus
         self._row_sharding = NamedSharding(self.mesh, P(AXIS, None))
+        self._live_sharding = NamedSharding(self.mesh, P(AXIS))
+        # tombstone mask (mutation subsystem): (S * cap_local,) bool sharded
+        # like the corpus rows; None until the first deletion so the
+        # delete-nothing programs stay byte-identical to pre-mutation
+        self._live = None
+        # rows masked before they reached the device corpus (deleted while
+        # still pending): applied at the next _sync
+        self._pending_dead: list = []
         self._append = jax.jit(
             lambda data, block, start: jax.lax.dynamic_update_slice(
                 data, block, (start, 0)
             ),
             donate_argnums=(0,),
             out_shardings=self._row_sharding,
+        )
+        self._mask_live = jax.jit(
+            lambda live, idx: live.at[idx].set(False, mode="drop"),
+            donate_argnums=(0,),
+            out_shardings=self._live_sharding,
         )
 
     @property
@@ -385,6 +418,13 @@ class ShardedFlatIndex(base.TpuIndex):
                     jnp.pad(self._dev, ((0, S * per - self._dev.shape[0]), (0, 0))),
                     self._row_sharding,
                 )
+            if self._live is not None:
+                # grown capacity rows are live until masked
+                self._live = jax.device_put(
+                    jnp.pad(self._live, (0, S * per - self._live.shape[0]),
+                            constant_values=True),
+                    self._live_sharding,
+                )
             self._cap_local = per
         if n_new:
             # incremental append: one dynamic_update_slice of the new rows
@@ -396,6 +436,37 @@ class ShardedFlatIndex(base.TpuIndex):
         self._pending = []
         self._synced_n = self._n
         self._update_counts()
+        if self._pending_dead:
+            # rows deleted while they were still host-pending: their flat
+            # positions are now materialized, mask them in the same sync
+            dead, self._pending_dead = self._pending_dead, []
+            self._mask_now(np.concatenate(dead))
+
+    def _mask_now(self, rows: np.ndarray) -> None:
+        if self._live is None:
+            self._live = jax.device_put(
+                jnp.ones((self.nshards * self._cap_local,), bool),
+                self._live_sharding,
+            )
+        bucket = base._next_pow2(rows.size, 1024)
+        idx = np.full(bucket, self._live.shape[0], np.int64)  # pad: dropped
+        idx[: rows.size] = rows
+        self._live = self._mask_live(self._live, jnp.asarray(idx))
+
+    def remove_rows(self, rows: np.ndarray) -> None:
+        """Tombstone rows (contiguous global ids == flat device positions):
+        one sharded scatter of False into the live mask, AND-ed with the
+        fill-count padding mask inside every sharded scan. Rows still
+        host-pending are deferred and masked by the _sync that lands them."""
+        rows = np.asarray(rows, np.int64)
+        if rows.size == 0:
+            return
+        pending = rows[rows >= self._synced_n]
+        synced = rows[rows < self._synced_n]
+        if pending.size:
+            self._pending_dead.append(pending)
+        if synced.size and self._dev is not None:
+            self._mask_now(synced)
 
     def search(self, q: np.ndarray, k: int):
         """One pjit launch per call, however many query blocks the batch
@@ -415,11 +486,11 @@ class ShardedFlatIndex(base.TpuIndex):
             q, k, self.metric,
             _counted(self, lambda b: _sharded_knn_jit(
                 b, self._dev, self._ntotals, self.mesh, k, self.metric,
-                chunk)),
+                chunk, live=self._live)),
             block=base.pick_query_block(65536 * 4),
             fused_fn=_counted(self, lambda q3: _sharded_knn_fused(
                 q3, self._dev, self._ntotals, self.mesh, k, self.metric,
-                chunk)),
+                chunk, live=self._live)),
         )
 
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
@@ -579,6 +650,51 @@ class ShardedPaddedLists:
         )
         return within
 
+    def mask_cells(self, cells: np.ndarray) -> None:
+        """Tombstone list cells (flat ``slot * cap + pos`` addresses over
+        the padded space): a per-shard drop-routed scatter of -1 into the
+        sharded ids plane — the same ``ids >= 0`` AND every sharded scan
+        (masked, routed, PQ) already applies then hides the row. Sizes are
+        not decremented (live (slot, pos) addresses stay stable until
+        compaction rewrites the lists)."""
+        cells = np.asarray(cells, np.int64)
+        if cells.size == 0:
+            return
+        bucket = base._next_pow2(cells.size, self.APPEND_BUCKET)
+        per = self.nlist_local * self.cap
+        cap = self.cap
+        # split the flat global address into (chip, chip-local position)
+        # on the HOST in int64: a global address over a big padded plane
+        # can exceed int32 (nlist_pad * cap > 2^31 at production scale —
+        # a silent wrap would drop the delete and resurrect the row on
+        # device), while the per-chip local position is bounded by the
+        # chip's own plane and the chip index by the mesh size
+        chip = np.full(bucket, -1, np.int64)
+        lpos_in = np.zeros(bucket, np.int64)
+        chip[: cells.size] = cells // per
+        lpos_in[: cells.size] = cells % per
+
+        def local(ids_local, chip, lpos_in):
+            me = jax.lax.axis_index(AXIS)
+            lpos = jnp.where(chip == me, lpos_in, per)
+            nl = ids_local.shape[0]
+            fids = ids_local.reshape(per).at[lpos].set(-1, mode="drop")
+            return fids.reshape(nl, cap)
+
+        fn = _shard_map_fn(
+            local,
+            mesh=self.mesh,
+            in_specs=(P(AXIS, None), P(), P()),
+            out_specs=P(AXIS, None),
+            check_vma=False,
+        )
+        # shape-keyed closure like _scatter: deletions are a cold,
+        # operator-driven path, and the bucket bounds the variant count
+        # graftlint: ok(recompile-hazard): shape-keyed closure, cold deletion path
+        self.ids = jax.jit(fn, donate_argnums=(0,))(
+            self.ids, jnp.asarray(chip.astype(np.int32)),
+            jnp.asarray(lpos_in.astype(np.int32)))
+
     def _scatter(self, pos, payload, gids):
         """Each shard drops updates outside its flat range (shard_map so the
         partitioner never replicates the sharded operands)."""
@@ -614,10 +730,41 @@ class ShardedPaddedLists:
         )
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric"))
+def _with_optional_rows(local, operands, specs, list_norms, raw_data,
+                        refining):
+    """Append the optional mesh-sharded per-list operands (stored norms,
+    raw refine rows) by presence and return ``(operands, specs,
+    wrapped)`` where ``wrapped`` re-binds them positionally to
+    ``local(*head, norms_local, raw_local)`` — ONE copy of the pop order
+    shared by the masked and routed scan drivers, so adding the next
+    optional operand cannot desync the two."""
+    head_n = len(operands)
+    operands = list(operands)
+    specs = list(specs)
+    have_norms = list_norms is not None
+    if have_norms:
+        operands.append(list_norms)
+        specs.append(P(AXIS, None))
+    if refining:
+        operands.append(raw_data)
+        specs.append(P(AXIS, None, None))
+
+    def wrapped(*args):
+        head = args[:head_n]
+        rest = list(args[head_n:])
+        norms_local = rest.pop(0) if have_norms else None
+        raw_local = rest.pop(0) if refining else None
+        return local(*head, norms_local, raw_local)
+
+    return operands, specs, wrapped
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric",
+                                             "scan_bf16", "adc_k"))
 def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
                              mesh, k: int, nprobe: int, g: int, metric: str,
-                             list_norms=None):
+                             list_norms=None, scan_bf16: bool = False,
+                             adc_k: int = 0, raw_data=None):
     """Corpus lists sharded across the mesh; probes masked by ownership.
 
     Every chip runs the same probe-group gathers against its local list
@@ -631,6 +778,15 @@ def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
     recomputed from the block, exactly like the single-chip scan in
     models/ivf.py so the two implementations can't drift; None keeps the
     recompute path (golden/A-B reference).
+
+    scan_bf16: bf16 MXU scan pass (halved compute-operand traffic) — the
+    model gates it behind refine_k_factor > 0 exactly like the single-chip
+    scan, so final scores stay exact. adc_k/raw_data enable that exact
+    refine (the ShardedIVFPQIndex pattern): the scan carries LOCAL cell
+    positions, keeps a per-chip shortlist of adc_k (= k * refine_k_factor),
+    rescores it exactly against the chip's fp16 raw rows (raw_data — same
+    padded-list layout as the payload lists), and only the refined (nq, k)
+    set rides the all_gather.
     """
     q = q.astype(jnp.float32)
     coarse = distance.pairwise_scores(q, centroids, metric)
@@ -640,16 +796,19 @@ def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
     qn = jnp.sum(q * q, axis=1, keepdims=True)
     S = mesh.shape[AXIS]
     groups = probes.reshape(nq, nprobe // g, g).transpose(1, 0, 2)
+    refining = raw_data is not None
+    local_k = adc_k if refining else k
 
-    def local(q, qn, groups, data_local, ids_local, sizes_local, norms_local):
+    def local(q, qn, groups, data_local, ids_local, sizes_local, norms_local,
+              raw_local):
         ax = jax.lax.axis_index(AXIS).astype(jnp.int32)
         # never-taken select: structural data dependency on the sharded input
         # so the scan carry's device-varying annotation matches the body
         # (shard_map vma rule); a select can't propagate NaN/Inf values
         anchor = jnp.where(jnp.zeros((), bool), data_local.reshape(-1)[0].astype(jnp.float32), 0.0)
         init = (
-            jnp.full((nq, k), distance.NEG_INF, jnp.float32) + anchor,
-            jnp.full((nq, k), -1, jnp.int32) + anchor.astype(jnp.int32),
+            jnp.full((nq, local_k), distance.NEG_INF, jnp.float32) + anchor,
+            jnp.full((nq, local_k), -1, jnp.int32) + anchor.astype(jnp.int32),
         )
 
         def body(carry, li):  # li: (nq, g) global list ids
@@ -659,8 +818,13 @@ def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
             block = data_local[slot].astype(jnp.float32)  # (nq, g, cap, d)
             ids = ids_local[slot]
             sizes = sizes_local[slot]
-            ip = jnp.einsum("qd,qgcd->qgc", q, block, precision=_HIGHEST,
-                            preferred_element_type=jnp.float32)
+            if scan_bf16:
+                ip = jnp.einsum("qd,qgcd->qgc", q.astype(jnp.bfloat16),
+                                block.astype(jnp.bfloat16),
+                                preferred_element_type=jnp.float32)
+            else:
+                ip = jnp.einsum("qd,qgcd->qgc", q, block, precision=_HIGHEST,
+                                preferred_element_type=jnp.float32)
             if metric == "dot":
                 s = ip
             else:
@@ -670,12 +834,34 @@ def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
             valid = (jnp.arange(cap)[None, None, :] < sizes[:, :, None])
             valid = valid & (ids >= 0) & mine[:, :, None]
             s = jnp.where(valid, s, distance.NEG_INF)
-            ids = jnp.where(valid, ids, -1)
+            if refining:
+                # carry LOCAL cell positions (one position addresses both
+                # the ids plane and the raw rows for the post-scan rerank
+                # — the ShardedIVFPQIndex refine contract)
+                carried = slot[:, :, None] * cap \
+                    + jnp.arange(cap, dtype=jnp.int32)[None, None, :]
+            else:
+                carried = ids
+            carried = jnp.where(valid, carried, -1)
             cv, cids = distance.segmented_topk_rows(
-                s.reshape(nq, g * cap), min(k, g * cap), ids.reshape(nq, g * cap))
-            return distance.merge_topk(best_v, best_i, cv, cids, k), None
+                s.reshape(nq, g * cap), min(local_k, g * cap),
+                carried.reshape(nq, g * cap))
+            return distance.merge_topk(best_v, best_i, cv, cids, local_k), None
 
-        (vals, ids), _ = jax.lax.scan(body, init, groups)
+        (vals, out), _ = jax.lax.scan(body, init, groups)
+        if refining:
+            pos = out
+            safe = jnp.where(pos >= 0, pos, 0)
+            ids = jnp.where(pos >= 0, ids_local.reshape(-1)[safe], -1)
+            # exact rerank of this chip's shortlist BEFORE the merge: the
+            # ICI then carries already-exact (nq, k) candidates
+            rows = raw_local.reshape(-1, raw_local.shape[-1])[safe]
+            s = ivfmod.exact_candidate_scores(q, rows, metric)
+            s = jnp.where(pos >= 0, s, distance.NEG_INF)
+            vals, best = jax.lax.top_k(s, k)
+            ids = jnp.take_along_axis(ids, best, axis=1)
+        else:
+            ids = out
         # merge the S local top-k sets over ICI
         av = jax.lax.all_gather(vals, AXIS)
         ai = jax.lax.all_gather(ids, AXIS)
@@ -684,36 +870,52 @@ def _sharded_ivf_flat_search(centroids, list_data, list_ids, list_sizes, q,
         best, pos = jax.lax.top_k(fv, k)
         return best, jnp.take_along_axis(fi, pos, axis=1)
 
-    if list_norms is not None:
-        fn = _shard_map_fn(
-            local,
-            mesh=mesh,
-            in_specs=(P(), P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS),
-                      P(AXIS, None)),
-            out_specs=(P(), P()),
-            check_vma=False,
-        )
-        return fn(q, qn, groups, list_data, list_ids, list_sizes, list_norms)
+    # operand list/specs assembled by presence (norms x raw combinations)
+    operands, specs, wrapped = _with_optional_rows(
+        local,
+        [q, qn, groups, list_data, list_ids, list_sizes],
+        [P(), P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS)],
+        list_norms, raw_data, refining)
+
     fn = _shard_map_fn(
-        lambda a, b, c, d, e, f: local(a, b, c, d, e, f, None),
+        wrapped,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS)),
+        in_specs=tuple(specs),
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return fn(q, qn, groups, list_data, list_ids, list_sizes)
+    return fn(*operands)
 
 
 class ShardedIVFFlatIndex(IVFFlatIndex):
     """IVF-Flat with mesh-sharded inverted lists: coarse k-means trains with
     psum reductions, list storage is partitioned across chip HBMs, search
     merges per-chip candidates over ICI. The full multi-chip serving path of
-    the ivf_tpu builder (enable with cfg.extra['shard_lists']=True)."""
+    the ivf_tpu builder (enable with cfg.extra['shard_lists']=True).
+
+    scan_bf16 + refine_k_factor are wired (ROADMAP item 2 leftover): the
+    bf16 MXU scan is legal only with the exact fp16 refine, enforced by the
+    parent constructor exactly like the single-chip index; the refine rows
+    live in a mesh-sharded raw-row sidecar laid out like the payload lists
+    (the ShardedIVFPQIndex pattern), rescored per chip BEFORE the ICI
+    merge. The fused pallas flat-scan kernel (pallas_flat) remains
+    single-chip-only: its scalar-prefetched gather indexes the global
+    (nlist, cap) layout, which shard_map's per-chip list blocks cannot
+    express without an ownership-compaction pass — a documented limitation
+    (docs/OPERATIONS.md#multi-chip-serving)."""
 
     def __init__(self, dim: int, nlist: int, metric: str = "l2",
                  mesh: Optional[Mesh] = None, kmeans_iters: int = 10,
-                 probe_routing: bool = False):
-        super().__init__(dim, nlist, metric, "f32", kmeans_iters=kmeans_iters)
+                 probe_routing: bool = False, refine_k_factor: int = 0,
+                 scan_bf16: bool = False):
+        super().__init__(dim, nlist, metric, "f32", kmeans_iters=kmeans_iters,
+                         refine_k_factor=refine_k_factor, scan_bf16=scan_bf16)
+        # the single-device refine store the parent builds is replaced by a
+        # mesh-sharded raw-row store laid out exactly like the payload
+        # lists (one (slot, pos) addresses both — the raw_lists precedent
+        # in ShardedIVFPQIndex)
+        self.refine_store = None
+        self.raw_lists: Optional[ShardedPaddedLists] = None
         self.mesh = mesh or make_mesh()
         # probe_routing: compact owned (query, probe) pairs per chip so the
         # scan FLOPs scale with the mesh (vs ownership masking, which only
@@ -731,13 +933,32 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
         # single-chip _make_lists)
         if self.metric == "l2":
             self.norm_lists = ShardedPaddedLists(self.nlist, (), np.float32, self.mesh)
+        if self.refine_k_factor:
+            self.raw_lists = ShardedPaddedLists(
+                self.nlist, (self.dim,), np.float16, self.mesh)
         return ShardedPaddedLists(self.nlist, (self.dim,), np.float32, self.mesh)
+
+    def _append_extra(self, x: np.ndarray, assign: np.ndarray, gids: np.ndarray,
+                      rows: np.ndarray) -> None:
+        if self.norm_lists is not None:
+            self.norm_lists.append(assign, self._row_norms(rows), gids)
+        if self.raw_lists is not None:
+            from distributed_faiss_tpu.models.ivf import clip_f16
+
+            # identical (assign, gids) stream as the payload lists ->
+            # identical slot layout and capacity
+            self.raw_lists.append(assign, clip_f16(x), gids)
 
     def search(self, q: np.ndarray, k: int):
         if self._n == 0:
             return self._empty_results(q.shape[0], k)
         nprobe = min(self.nprobe, self.nlist)
         norms = self._scan_norms()
+        refining = bool(self.refine_k_factor) and self.raw_lists is not None
+        if refining and self.raw_lists.cap != self.lists.cap:
+            raise RuntimeError("raw/payload list capacities diverged")
+        adc_k = k * self.refine_k_factor if refining else 0
+        raw = self.raw_lists.data if refining else None
         if self.probe_routing:
             # pair group sized so the (group, cap, d) fp32 block stays <=64MB
             group = max(8, min(1024, (64 << 20) // max(1, self.lists.cap * self.dim * 4)))
@@ -747,7 +968,9 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
                     self.centroids, self.lists.data, self.lists.ids,
                     self.lists.sizes, block, n, self.mesh, k, nprobe, bucket,
                     group, self.metric, list_norms=norms,
+                    scan_bf16=self.scan_bf16, adc_k=adc_k, raw_data=raw,
                 )),
+                local_k=adc_k or k,
             )
         nb = base.pick_query_block(self.lists.cap * self.dim * 4)
         gsz = probe_group_size(nprobe, nb * self.lists.cap * self.dim * 4)
@@ -756,11 +979,13 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
             _counted(self, lambda b: _sharded_ivf_flat_search(
                 self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
                 b, self.mesh, k, nprobe, gsz, self.metric, list_norms=norms,
+                scan_bf16=self.scan_bf16, adc_k=adc_k, raw_data=raw,
             )),
             block=nb,
             fused_fn=_counted(self, lambda q3: _sharded_ivf_flat_search_fused(
                 self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
                 q3, self.mesh, k, nprobe, gsz, self.metric, list_norms=norms,
+                scan_bf16=self.scan_bf16, adc_k=adc_k, raw_data=raw,
             )),
         )
 
@@ -768,17 +993,31 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
         state = super().state_dict()
         state["kind"] = "sharded_ivf_flat"
         state["probe_routing"] = self.probe_routing
+        if self.raw_lists is not None and self._n:
+            # stream the fp16 refine rows back through the shared
+            # id -> (list, pos) map (the ShardedIVFPQIndex pattern)
+            out = np.zeros((self._n, self.dim), np.float16)
+            chunk = 1 << 20
+            for s in range(0, self._n, chunk):
+                e = min(self._n, s + chunk)
+                ids = np.arange(s, e, dtype=np.int64)
+                out[s:e] = base.gather_list_rows(
+                    self.raw_lists, self._host_assign_array()[ids],
+                    self._host_pos_array()[ids])
+            state["refine_rows"] = out
         return state
 
     @classmethod
     def from_state_dict(cls, state):
         idx = cls(int(state["dim"]), int(state["nlist"]), str(state["metric"]),
-                  probe_routing=bool(state.get("probe_routing", False)))
+                  probe_routing=bool(state.get("probe_routing", False)),
+                  refine_k_factor=int(state.get("refine_k_factor", 0)),
+                  scan_bf16=bool(state.get("scan_bf16", False)))
         idx.nprobe = int(state["nprobe"])
         if not bool(state["trained"]):
             return idx
         idx.centroids = jnp.asarray(state["centroids"])
-        idx.lists = idx._make_lists()
+        idx.lists = idx._make_lists()  # also builds raw_lists when refining
         rows, assign = state["rows"], state["assign"]
         if rows.shape[0]:
             gids = np.arange(rows.shape[0], dtype=np.int64)
@@ -788,13 +1027,22 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
             idx._n = rows.shape[0]
             # snapshot norms when present, backfill pre-norms snapshots
             idx._restore_norms(state, rows, assign, gids)
+            if idx.raw_lists is not None:
+                if "refine_rows" not in state:
+                    raise ValueError(
+                        "sharded IVF-flat state has refine_k_factor set but "
+                        "no refine_rows payload")
+                idx.raw_lists.append(
+                    assign, np.asarray(state["refine_rows"], np.float16), gids)
         return idx
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric"))
+@functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "g", "metric",
+                                             "scan_bf16", "adc_k"))
 def _sharded_ivf_flat_search_fused(centroids, list_data, list_ids, list_sizes, q3,
                                    mesh, k: int, nprobe: int, g: int, metric: str,
-                                   list_norms=None):
+                                   list_norms=None, scan_bf16: bool = False,
+                                   adc_k: int = 0, raw_data=None):
     """Multi-block sharded search in one launch: lax.map over stacked query
     blocks, shard_map per block inside (launch-bound serving — see
     models.base.pick_query_block)."""
@@ -802,7 +1050,9 @@ def _sharded_ivf_flat_search_fused(centroids, list_data, list_ids, list_sizes, q
     def body(qb):
         return _sharded_ivf_flat_search(centroids, list_data, list_ids,
                                         list_sizes, qb, mesh, k, nprobe, g,
-                                        metric, list_norms=list_norms)
+                                        metric, list_norms=list_norms,
+                                        scan_bf16=scan_bf16, adc_k=adc_k,
+                                        raw_data=raw_data)
 
     return jax.lax.map(body, q3)
 
@@ -1247,18 +1497,23 @@ def _routed_pairs_local(probes, nq_real, nprobe: int, pair_bucket: int,
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "pair_bucket",
-                                             "group", "metric"))
+                                             "group", "metric", "scan_bf16",
+                                             "adc_k"))
 def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, q,
                                     nq_real, mesh, k: int, nprobe: int,
                                     pair_bucket: int, group: int, metric: str,
-                                    list_norms=None):
+                                    list_norms=None, scan_bf16: bool = False,
+                                    adc_k: int = 0, raw_data=None):
     """Probe-routed sharded IVF: FLOPs scale with the mesh, not just capacity.
 
     The masked variant (_sharded_ivf_flat_search) has every chip do the full
     (nq x nprobe) gather/einsum work and zero out non-owned probes. Here each
     chip scores only the pairs it owns (see _routed_pairs_local).
     list_norms: sharded stored-norms sidecar (see _sharded_ivf_flat_search);
-    None recomputes from the block.
+    None recomputes from the block. scan_bf16 runs the pair einsum in bf16
+    (model-gated behind refine); adc_k/raw_data enable the pre-merge exact
+    refine via _routed_pairs_local's position-carrying path (the routed PQ
+    precedent).
 
     pair_bucket bounds per-chip work; pairs beyond it are DROPPED (skewed
     ownership). The third return value is the max dropped-pairs count across
@@ -1273,9 +1528,10 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
     cap = list_data.shape[1]
     S = mesh.shape[AXIS]
     qn = jnp.sum(q * q, axis=1, keepdims=True)
+    refining = raw_data is not None
 
     def local(q, qn, probes, nq_real, data_local, ids_local, sizes_local,
-              norms_local):
+              norms_local, raw_local):
         anchor = jnp.where(jnp.zeros((), bool),
                            data_local.reshape(-1)[0].astype(jnp.float32), 0.0)
 
@@ -1284,8 +1540,13 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
             block = data_local[slot].astype(jnp.float32)  # (g, cap, d)
             ids = ids_local[slot]
             sizes = sizes_local[slot]
-            ip = jnp.einsum("bd,bcd->bc", qv, block, precision=_HIGHEST,
-                            preferred_element_type=jnp.float32)
+            if scan_bf16:
+                ip = jnp.einsum("bd,bcd->bc", qv.astype(jnp.bfloat16),
+                                block.astype(jnp.bfloat16),
+                                preferred_element_type=jnp.float32)
+            else:
+                ip = jnp.einsum("bd,bcd->bc", qv, block, precision=_HIGHEST,
+                                preferred_element_type=jnp.float32)
             if metric == "dot":
                 s = ip
             else:
@@ -1294,31 +1555,33 @@ def _sharded_ivf_flat_search_routed(centroids, list_data, list_ids, list_sizes, 
                 s = -(qn[qi] - 2.0 * ip + bn)
             ok = (jnp.arange(cap)[None, :] < sizes[:, None]) & (ids >= 0)
             ok = ok & valid[:, None]
-            return jnp.where(ok, s, distance.NEG_INF), jnp.where(ok, ids, -1)
+            s = jnp.where(ok, s, distance.NEG_INF)
+            ids = jnp.where(ok, ids, -1)
+            if not refining:
+                return s, ids
+            pos = slot[:, None] * cap + jnp.arange(cap, dtype=jnp.int32)[None, :]
+            return s, ids, jnp.where(ok, pos, -1)
 
         return _routed_pairs_local(probes, nq_real, nprobe, pair_bucket, group,
-                                   k, cap, S, anchor, score_group)
+                                   k, cap, S, anchor, score_group,
+                                   q=q, raw_local=raw_local, metric=metric,
+                                   adc_k=adc_k)
 
-    if list_norms is not None:
-        fn = _shard_map_fn(
-            local,
-            mesh=mesh,
-            in_specs=(P(), P(), P(), P(), P(AXIS, None, None), P(AXIS, None),
-                      P(AXIS), P(AXIS, None)),
-            out_specs=(P(), P(), P()),
-            check_vma=False,
-        )
-        return fn(q, qn, probes, jnp.asarray(nq_real, jnp.int32),
-                  list_data, list_ids, list_sizes, list_norms)
+    operands, specs, wrapped = _with_optional_rows(
+        local,
+        [q, qn, probes, jnp.asarray(nq_real, jnp.int32),
+         list_data, list_ids, list_sizes],
+        [P(), P(), P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS)],
+        list_norms, raw_data, refining)
+
     fn = _shard_map_fn(
-        lambda a, b, c, d, e, f, g_: local(a, b, c, d, e, f, g_, None),
+        wrapped,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(AXIS, None, None), P(AXIS, None), P(AXIS)),
+        in_specs=tuple(specs),
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
-    return fn(q, qn, probes, jnp.asarray(nq_real, jnp.int32),
-              list_data, list_ids, list_sizes)
+    return fn(*operands)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "pair_bucket",

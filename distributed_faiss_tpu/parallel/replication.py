@@ -37,8 +37,36 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from distributed_faiss_tpu.utils import lockdep
+from distributed_faiss_tpu.utils.state import (
+    NOT_TRAINED_REJECTION_FMT,
+    IndexState,
+)
 
 logger = logging.getLogger()
+
+
+# the engine's transient search rejection while a replica drains its add
+# buffer (engine.py _device_search: state == ADD). Matched as a substring
+# of the ServerException's remote traceback text — deliberately NARROW
+# (the state name is included) so only the drain window qualifies; a
+# NOT_TRAINED rejection, a missing index, or bad args still repeat
+# identically on every replica and must keep raising. Built from the
+# raise sites' shared format (utils/state.py) so a reword there cannot
+# silently disable failover.
+_DRAIN_REJECTION = NOT_TRAINED_REJECTION_FMT.format(state=IndexState.ADD)
+
+
+def drain_failover_eligible(exc: BaseException) -> bool:
+    """True when a replica's application error is the transient mid-ADD
+    (buffer drain) rejection — the last read-unavailability window from
+    ROADMAP item 1. The replicated read path treats ONLY this application
+    error as group-failover-eligible: an R >= 2 group keeps serving from
+    the peer while one replica drains, instead of surfacing the engine's
+    rejection to the caller."""
+    from distributed_faiss_tpu.parallel import rpc
+
+    return (isinstance(exc, rpc.ServerException)
+            and _DRAIN_REJECTION in str(exc))
 
 
 def quorum_size(replication: int, write_quorum: int = 0) -> int:

@@ -155,6 +155,28 @@ class IndexServer:
             query_batch, top_k=top_k, return_embeddings=return_embeddings
         )
 
+    # ------------------------------------------------------------- mutation
+
+    def remove_ids(self, index_id: str, ids) -> int:
+        """Tombstone rows by metadata id (mutation subsystem): masked on
+        device immediately, persisted to the sidecar before the ack —
+        a crash after this returns can never resurrect the rows. One of
+        the new wire ops; like every op it rides both serving loops
+        (mux worker-pool dispatch and the legacy sync path)."""
+        return self._get_index(index_id).remove_ids(ids)
+
+    def upsert(self, index_id: str, ids, embeddings, metadata=None) -> int:
+        """Delete + add under one op: the ids' live rows stop serving
+        before the ack; replacements ingest through the normal buffered
+        add path (visible when their chunk drains, like any add)."""
+        return self._get_index(index_id).upsert(ids, embeddings, metadata)
+
+    def compact_index(self, index_id: str) -> bool:
+        """Operator-triggered compaction pass (the background watcher
+        normally drives this once the tombstone fraction crosses
+        DFT_COMPACT_THRESHOLD)."""
+        return self._get_index(index_id).compact()
+
     def sync_train(self, index_id: str) -> None:
         self._get_index(index_id).train()
 
@@ -335,6 +357,10 @@ class IndexServer:
         with self.indexes_lock:
             snapshot = list(self.indexes.items())
         out["engine"] = {iid: idx.perf_stats() for iid, idx in snapshot}
+        # mutation observability (mutation subsystem): per-index tombstone
+        # counts, live fraction, compaction run/aborted/fallback counters,
+        # and compaction latency — docs/OPERATIONS.md#mutable-corpora
+        out["mutation"] = {iid: idx.mutation_stats() for iid, idx in snapshot}
         return out
 
     def ping(self) -> dict:

@@ -9,6 +9,14 @@ TRAINING dominates, then NOT_TRAINED, then ADD, else TRAINED.
 from enum import Enum
 from typing import List
 
+# The engine's search rejection while an index is not TRAINED, raised at
+# every device-search entry (engine._device_search/_search_reconstruct).
+# Shared as a format so the replicated read path's drain-failover matcher
+# (parallel/replication.py) can never drift from the raise sites: with
+# state=ADD this exact text is what classifies a replica as "transiently
+# draining its add buffer" and group-failover-eligible.
+NOT_TRAINED_REJECTION_FMT = "Server index is not trained. state: {state}"
+
 
 class IndexState(Enum):
     NOT_TRAINED = 1

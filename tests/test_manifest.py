@@ -107,7 +107,9 @@ def test_save_writes_generation_and_manifest(tmp_path, rng):
     assert [g for g, _ in gens] == [1]
     manifest = serialization.load_manifest(gens[0][1])
     assert serialization.verify_manifest(storage, manifest) == []
-    assert set(manifest["files"]) == {"index", "meta", "buffer", "cfg"}
+    # the mutation tombstone sidecar is part of every committed set
+    assert set(manifest["files"]) == {"index", "meta", "buffer", "cfg",
+                                      "tombstones"}
     assert manifest["ntotal"] == idx.tpu_index.ntotal
     # unversioned cfg.json convenience copy for get_config_path readers
     assert os.path.isfile(os.path.join(storage, "cfg.json"))

@@ -1,0 +1,594 @@
+"""Mutable corpora (mutation subsystem): tombstone deletes, upsert, the
+crash-safe sidecar protocol, and background compaction — engine + model
+layer. Fast tests run in tier-1; the marker mirrors the other subsystem
+tiers (CI job ``mutation``)."""
+
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.engine import Index
+from distributed_faiss_tpu.models.flat import FlatIndex
+from distributed_faiss_tpu.models.ivf import IVFFlatIndex, IVFPQIndex
+from distributed_faiss_tpu.mutation import compaction, tombstones
+from distributed_faiss_tpu.mutation.tombstones import TombstoneSet
+from distributed_faiss_tpu.utils import serialization
+from distributed_faiss_tpu.utils.config import IndexCfg, MutationCfg
+
+pytestmark = pytest.mark.mutation
+
+DIM = 16
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+@pytest.fixture(autouse=True)
+def _no_background_compaction(monkeypatch):
+    """Deterministic tests drive compaction explicitly; the watcher tier
+    has its own test below."""
+    monkeypatch.setenv("DFT_COMPACT", "0")
+
+
+def flat_cfg(tmp_path, **kw):
+    kw.setdefault("index_builder_type", "flat")
+    kw.setdefault("dim", DIM)
+    kw.setdefault("metric", "l2")
+    kw.setdefault("train_num", 10)
+    kw.setdefault("index_storage_dir", str(tmp_path / "shard"))
+    return IndexCfg(**kw)
+
+
+def build_engine(tmp_path, rng, n=200, **kw):
+    cfg = flat_cfg(tmp_path, **kw)
+    idx = Index(cfg)
+    x = rng.standard_normal((n, DIM)).astype(np.float32)
+    idx.add_batch(x, [(i,) for i in range(n)], train_async_if_triggered=False)
+    wait_drained(idx, n)
+    return idx, x
+
+
+def wait_drained(idx, n, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if idx.get_idx_data_num() == (0, n):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"engine never drained to {n} rows: "
+                         f"{idx.get_idx_data_num()}")
+
+
+# ------------------------------------------------------------ model layer
+
+
+@pytest.mark.parametrize("metric", ["l2", "dot"])
+def test_flat_delete_matches_rebuilt_index(rng, metric):
+    """The delete-then-search byte-identity gate: a masked index answers
+    exactly like an index freshly built over the surviving rows."""
+    x = rng.standard_normal((300, DIM)).astype(np.float32)
+    q = x[:6]
+    idx = FlatIndex(DIM, metric)
+    idx.train(x)
+    idx.add(x)
+    d_before, _ = idx.search(q, 8)
+    dead = np.arange(0, 90)
+    idx.remove_rows(dead)
+    d, i = idx.search(q, 8)
+    assert not np.intersect1d(i.ravel(), dead).size
+    fresh = FlatIndex(DIM, metric)
+    fresh.train(x)
+    fresh.add(x[90:])
+    d2, i2 = fresh.search(q, 8)
+    np.testing.assert_array_equal(d, d2)
+    np.testing.assert_array_equal(i - 90, i2)
+    # idempotent: re-deleting changes nothing
+    idx.remove_rows(dead[:10])
+    d3, i3 = idx.search(q, 8)
+    np.testing.assert_array_equal(d, d3)
+    np.testing.assert_array_equal(i, i3)
+
+
+def test_delete_nothing_is_byte_identical(rng):
+    """remove_rows([]) leaves the live mask unmaterialized: the exact
+    pre-mutation program serves, byte-identical results."""
+    x = rng.standard_normal((200, DIM)).astype(np.float32)
+    idx = FlatIndex(DIM, "l2")
+    idx.train(x)
+    idx.add(x)
+    d0, i0 = idx.search(x[:4], 5)
+    idx.remove_rows(np.zeros(0, np.int64))
+    assert idx.store.live is None
+    d1, i1 = idx.search(x[:4], 5)
+    np.testing.assert_array_equal(d0, d1)
+    np.testing.assert_array_equal(i0, i1)
+
+
+def test_ivf_flat_delete_matches_rebuilt(rng):
+    """IVF tombstones ride the device ids plane (-1 == padding to every
+    scan entry): full-probe masked search equals a rebuild over the
+    survivors with the same centroids."""
+    x = rng.standard_normal((400, DIM)).astype(np.float32)
+    q = x[:6]
+    idx = IVFFlatIndex(DIM, 8, "l2")
+    idx.train(x)
+    idx.add(x)
+    idx.set_nprobe(8)
+    dead = np.arange(17, 140)
+    idx.remove_rows(dead)
+    d, i = idx.search(q, 10)
+    assert not np.intersect1d(i.ravel(), dead).size
+    keep = np.ones(400, bool)
+    keep[dead] = False
+    fresh = IVFFlatIndex(DIM, 8, "l2")
+    fresh.train(x)  # same seeded k-means -> same centroids
+    fresh.add(x[keep])
+    fresh.set_nprobe(8)
+    d2, _ = fresh.search(q, 10)
+    np.testing.assert_array_equal(d, d2)
+
+
+def test_ivf_pq_delete_never_surfaces(rng):
+    x = rng.standard_normal((600, 32)).astype(np.float32)
+    idx = IVFPQIndex(32, 4, m=8)
+    idx.train(x)
+    idx.add(x)
+    idx.set_nprobe(4)
+    dead = np.arange(0, 200)
+    idx.remove_rows(dead)
+    _, i = idx.search(x[:8], 10)
+    assert not np.intersect1d(i.ravel(), dead).size
+
+
+def test_k_exceeding_live_rows_returns_sentinels(rng):
+    x = rng.standard_normal((10, DIM)).astype(np.float32)
+    idx = FlatIndex(DIM, "l2")
+    idx.train(x)
+    idx.add(x)
+    idx.remove_rows(np.arange(7))
+    d, i = idx.search(x[:3], 8)
+    # 3 live rows, k=8: the tail is -1/inf, no deleted id ever surfaces
+    assert (i[:, 3:] == -1).all()
+    assert not np.intersect1d(i.ravel(), np.arange(7)).size
+
+
+def test_unsupported_kind_raises_cleanly():
+    from distributed_faiss_tpu.models import base
+
+    class Stub(base.TpuIndex):
+        def __init__(self):
+            super().__init__(4, "l2")
+
+    with pytest.raises(RuntimeError, match="does not support remove"):
+        Stub().remove_rows(np.arange(3))
+
+
+def test_compact_state_filters_and_rejects(rng):
+    x = rng.standard_normal((100, DIM)).astype(np.float32)
+    idx = FlatIndex(DIM, "l2")
+    idx.train(x)
+    idx.add(x)
+    keep = np.ones(100, bool)
+    keep[::2] = False
+    out = compaction.compact_state(idx.state_dict(), keep)
+    assert out["ntotal"] == 50 and out["data"].shape[0] == 50
+    with pytest.raises(compaction.CompactionUnsupported):
+        compaction.compact_state({"kind": "hnswsq"}, keep)
+    with pytest.raises(ValueError):
+        compaction.compact_state(idx.state_dict(), keep[:10])
+
+
+def test_tombstone_payload_round_trip():
+    t = TombstoneSet({3: (3,), 7: None}, layout=5)
+    p = t.to_payload()
+    t2 = TombstoneSet.from_payload(p)
+    assert sorted(t2.rows()) == [3, 7] and t2.layout == 5
+    t2.merge_payload({"dead_rows": [7, 9], "dead_ids": ["x", "y"]})
+    assert sorted(t2.rows()) == [3, 7, 9]
+    assert 9 in t2 and len(t2) == 3
+    # arbitrary ids survive the dump (tuples as JSON arrays, objects via
+    # default=str), and json.loads round-trips the payload
+    import json
+
+    loaded = json.loads(tombstones.dump_payload(p))
+    assert loaded["dead_rows"] == [3, 7] and loaded["dead_ids"] == [[3], None]
+
+
+def test_mutation_cfg_validation(monkeypatch):
+    assert MutationCfg().threshold == 0.25
+    monkeypatch.setenv("DFT_COMPACT_THRESHOLD", "0.5")
+    monkeypatch.setenv("DFT_COMPACT", "0")
+    cfg = MutationCfg.from_env()
+    assert cfg.threshold == 0.5 and not cfg.compact
+    with pytest.raises(ValueError):
+        MutationCfg(threshold=1.5)
+    with pytest.raises(ValueError):
+        MutationCfg(interval_s=0)
+
+
+# ------------------------------------------------------------ engine layer
+
+
+def test_engine_remove_ids_and_get_ids(tmp_path, rng):
+    idx, x = build_engine(tmp_path, rng)
+    assert idx.remove_ids([5, 6, 7]) == 3
+    assert idx.remove_ids([5, 6]) == 0  # already dead
+    assert idx.remove_ids([]) == 0
+    d, m, _ = idx.search(x[5:8], 4)
+    dead_meta = {(5,), (6,), (7,)}
+    assert not any(mm in dead_meta for row in m for mm in row)
+    assert idx.get_ids() == set(range(200)) - {5, 6, 7}
+    st = idx.mutation_stats()
+    assert st["tombstoned_rows"] == 3
+    assert st["live_fraction"] == pytest.approx(197 / 200)
+    # the delete was durable before remove_ids returned
+    side = tombstones.load_sidecar(idx.cfg.index_storage_dir)
+    assert sorted(side["dead_rows"]) == [5, 6, 7]
+
+
+def test_engine_upsert_visibility_ordering(tmp_path, rng):
+    """Old row stops serving when upsert returns; the new row serves after
+    its drain — never both."""
+    idx, x = build_engine(tmp_path, rng)
+    q = x[42:43]
+    _, m0, _ = idx.search(q, 1)
+    assert m0[0][0] == (42,)
+    new_vec = -x[42:43]  # far from the old one
+    assert idx.upsert([42], new_vec, [(42,)]) == 1
+    # from the moment upsert returns, the OLD row never serves again —
+    # poll through the engine's transient mid-ADD rejection (the drain
+    # window clients fail over across; see parallel/replication.py)
+    saw_new = False
+    deadline = time.time() + 30
+    while time.time() < deadline and not saw_new:
+        try:
+            _, m1, _ = idx.search(np.concatenate([q, new_vec]), 1)
+        except RuntimeError as e:
+            assert "not trained" in str(e)
+            time.sleep(0.01)
+            continue
+        assert m1[0][0] != (42,), "old row resurfaced after upsert"
+        saw_new = m1[1][0] == (42,)
+    assert saw_new, "new row never became visible"
+    wait_drained(idx, 201)
+    # exactly one live row carries the id
+    d3, m3, _ = idx.search(np.concatenate([q, new_vec]), 3)
+    flat = [mm for row in m3 for mm in row if mm == (42,)]
+    assert len(flat) == 1
+
+
+def test_engine_buffered_delete_never_serves(tmp_path, rng):
+    """An id still in the add buffer at delete time is dropped when its
+    chunk drains — it never serves."""
+    cfg = flat_cfg(tmp_path, train_num=0)
+    idx = Index(cfg)
+    x = rng.standard_normal((50, DIM)).astype(np.float32)
+    # NOT_TRAINED: everything sits in the buffer
+    idx.add_batch(x, [(i,) for i in range(50)],
+                  train_async_if_triggered=False)
+    assert idx.remove_ids([3, 4]) == 2
+    idx.cfg.train_num = 10
+    idx.train()
+    wait_drained(idx, 50)
+    d, m, _ = idx.search(x[3:5], 3)
+    assert not any(mm in {(3,), (4,)} for row in m for mm in row)
+    assert idx.get_ids() == set(range(50)) - {3, 4}
+
+
+def test_scheduler_window_sees_consistent_tombstone_snapshot(tmp_path, rng):
+    """No torn mask mid-window: a batched window of IDENTICAL queries must
+    return identical rows even while deletes land concurrently — the mask
+    scatter and the device launch serialize on index_lock."""
+    idx, x = build_engine(tmp_path, rng, n=150)
+    q = np.tile(x[100:101], (24, 1))
+    stop = threading.Event()
+    bad = []
+
+    def storm():
+        while not stop.is_set():
+            try:
+                d, m, _ = idx.search_batched(q, 5)
+            except Exception as e:  # pragma: no cover - fails the test below
+                bad.append(repr(e))
+                return
+            for r in range(1, q.shape[0]):
+                if m[r] != m[0] or not np.array_equal(d[r], d[0]):
+                    bad.append((m[0], m[r]))
+                    return
+
+    threads = [threading.Thread(target=storm) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for victim in range(0, 40):
+        idx.remove_ids([victim])
+        time.sleep(0.005)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not bad, f"torn window observed: {bad[:2]}"
+
+
+# -------------------------------------------------- persistence + fallback
+
+
+def test_sidecar_survives_save_load(tmp_path, rng):
+    idx, x = build_engine(tmp_path, rng)
+    idx.remove_ids([1, 2, 3])
+    d0, m0, _ = idx.search(x[:4], 5)
+    idx.save()
+    idx2 = Index.from_storage_dir(idx.cfg.index_storage_dir, ignore_buffer=False)
+    d1, m1, _ = idx2.search(x[:4], 5)
+    np.testing.assert_array_equal(d0, d1)
+    assert m0 == m1
+    assert idx2.mutation_stats()["tombstoned_rows"] == 3
+
+
+def test_delete_after_save_survives_crash_without_new_save(tmp_path, rng):
+    """The standalone sidecar alone carries deletes made after the last
+    committed generation (the SIGKILL-right-after-remove_ids case)."""
+    idx, x = build_engine(tmp_path, rng)
+    idx.save()
+    idx.remove_ids([10, 11])  # NO save afterwards — simulated crash
+    idx2 = Index.from_storage_dir(idx.cfg.index_storage_dir, ignore_buffer=False)
+    _, m, _ = idx2.search(x[10:12], 3)
+    assert not any(mm in {(10,), (11,)} for row in m for mm in row)
+    assert idx2.mutation_stats()["tombstoned_rows"] == 2
+
+
+def test_sidecar_survives_torn_generation_fallback(tmp_path, rng):
+    """Quarantine + fallback to the previous generation must keep every
+    delete: same-layout positions apply directly; positions keyed to a
+    layout that tore re-apply BY ID."""
+    idx, x = build_engine(tmp_path, rng)
+    idx.remove_ids([1, 2])
+    idx.save()
+    assert idx.compact()
+    idx.remove_ids([30, 31])  # recorded against the compacted layout
+    storage = idx.cfg.index_storage_dir
+    gen, mpath = serialization.list_generations(storage)[0]
+    manifest = serialization.load_manifest(mpath)
+    with open(os.path.join(storage, manifest["files"]["index"]["name"]),
+              "ab") as f:
+        f.write(b"torn")
+    idx2 = Index.from_storage_dir(storage, ignore_buffer=False)
+    st = idx2.mutation_stats()
+    assert st["load_fallbacks"] == 1
+    assert st["tombstoned_rows"] == 4  # 2 positional + 2 by-id
+    _, m, _ = idx2.search(x[[1, 2, 30, 31]], 3)
+    deadm = {(1,), (2,), (30,), (31,)}
+    assert not any(mm in deadm for row in m for mm in row)
+    # the torn generation is evidence, not garbage
+    assert os.path.isdir(os.path.join(storage, "quarantine"))
+
+
+# ------------------------------------------------------------- compaction
+
+
+def test_compaction_reclaims_and_preserves_results(tmp_path, rng):
+    idx, x = build_engine(tmp_path, rng)
+    dead = list(range(0, 60))
+    idx.remove_ids(dead)
+    d0, m0, _ = idx.search(x[:6], 8)
+    assert idx.tombstone_fraction() == pytest.approx(0.3)
+    assert idx.compact()
+    assert idx.tombstone_fraction() == 0.0
+    assert idx.tpu_index.ntotal == 140
+    d1, m1, _ = idx.search(x[:6], 8)
+    np.testing.assert_array_equal(d0, d1)
+    assert m0 == m1
+    st = idx.mutation_stats()
+    assert st["compactions"] == 1 and st["layout_generation"] >= 1
+    assert "compaction_s" in st
+    # the compacted generation reloads byte-identically
+    idx2 = Index.from_storage_dir(idx.cfg.index_storage_dir,
+                                  ignore_buffer=False)
+    d2, m2, _ = idx2.search(x[:6], 8)
+    np.testing.assert_array_equal(d1, d2)
+    assert m1 == m2
+    assert idx2.mutation_stats()["tombstoned_rows"] == 0
+    # compacting with nothing dead is a no-op
+    assert not idx.compact()
+
+
+def test_compaction_matches_freshly_built_index(tmp_path, rng):
+    idx, x = build_engine(tmp_path, rng)
+    idx.remove_ids(list(range(50, 120)))
+    assert idx.compact()
+    d, m, _ = idx.search(x[:5], 6)
+    keep = [i for i in range(200) if not 50 <= i < 120]
+    fresh = FlatIndex(DIM, "l2")
+    fresh.train(x)
+    fresh.add(x[keep])
+    df, idf = fresh.search(x[:5], 6)
+    np.testing.assert_array_equal(d, df)  # engine D == model D (l2)
+    assert [[(keep[j],) for j in row] for row in idf.tolist()] == m
+
+
+def test_compaction_composes_with_later_adds_and_deletes(tmp_path, rng):
+    """Deletes and adds around a compaction keep positional integrity:
+    the layout renumbers, metadata follows, later ids stay correct."""
+    idx, x = build_engine(tmp_path, rng, n=100)
+    extra = rng.standard_normal((20, DIM)).astype(np.float32)
+    idx.remove_ids(list(range(0, 30)))
+    idx.add_batch(extra, [(100 + i,) for i in range(20)],
+                  train_async_if_triggered=False)
+    wait_drained(idx, 120)
+    idx.remove_ids([105])
+    assert idx.compact()
+    _, m, _ = idx.search(extra[5:6], 3)
+    assert not any(mm == (105,) for row in m for mm in row)
+    assert idx.get_ids() == (set(range(100, 120)) | set(range(30, 100))) - {105}
+
+
+def test_background_watcher_compacts_over_threshold(tmp_path, rng, monkeypatch):
+    monkeypatch.setenv("DFT_COMPACT", "1")
+    monkeypatch.setenv("DFT_COMPACT_INTERVAL", "0.2")
+    monkeypatch.setenv("DFT_COMPACT_THRESHOLD", "0.25")
+    idx, x = build_engine(tmp_path, rng, n=100)
+    assert idx.mutation_cfg.compact
+    idx.remove_ids(list(range(40)))  # 0.4 > threshold
+    deadline = time.time() + 30
+    while idx.mutation_stats()["compactions"] < 1:
+        assert time.time() < deadline, "watcher never compacted"
+        time.sleep(0.05)
+    assert idx.tombstone_fraction() == 0.0
+    # retire stops the watcher (rides the same event as the save watcher)
+    idx.retire()
+
+
+def test_sigkill_mid_compaction_falls_back_with_tombstones(tmp_path, rng):
+    """In-process stand-in for the chaos gate's crash window: a compaction
+    that never reaches its commit leaves the previous generation + sidecar
+    pair fully intact."""
+    idx, x = build_engine(tmp_path, rng)
+    idx.remove_ids(list(range(0, 60)))
+    idx.save()
+    storage = idx.cfg.index_storage_dir
+    gens_before = serialization.list_generations(storage)
+    # simulate the kill: run phases 1-2, then DON'T commit (the chaos test
+    # kills the real process inside DFT_COMPACT_TEST_DELAY_S; here we just
+    # never call compact). The on-disk state is exactly what a mid-phase-2
+    # kill leaves: last generation + sidecar.
+    idx2 = Index.from_storage_dir(storage, ignore_buffer=False)
+    assert serialization.list_generations(storage)[0][0] == gens_before[0][0]
+    assert idx2.mutation_stats()["tombstoned_rows"] == 60
+    d, m, _ = idx2.search(x[:4], 5)
+    dead = {(i,) for i in range(60)}
+    assert not any(mm in dead for row in m for mm in row)
+
+
+# ------------------------------------------- review regressions (PR 9)
+
+
+def test_buffered_delete_on_unsupported_kind_rejected_up_front(
+        tmp_path, rng, monkeypatch):
+    """A delete whose rows are ALL still buffered must raise on an index
+    kind without a tombstone mask — BEFORE any tombstone is recorded.
+    Accepting it used to kill the drain worker at mask time (base-class
+    remove_rows raise) and wedge the engine in ADD forever."""
+    from distributed_faiss_tpu.models import hnsw
+
+    # pretend the native graph is available so the hnswsq builder resolves
+    # to the maskless HNSWSQIndex instead of the FlatIndex fallback
+    monkeypatch.setattr(hnsw, "native_available", lambda: True)
+    cfg = flat_cfg(tmp_path, index_builder_type="hnswsq", train_num=1000)
+    idx = Index(cfg)
+    x = rng.standard_normal((20, DIM)).astype(np.float32)
+    idx.add_batch(x, [(i,) for i in range(20)],
+                  train_async_if_triggered=False)
+    assert idx.tpu_index is None  # below train_num: everything buffered
+    with pytest.raises(RuntimeError, match="does not support remove"):
+        idx.remove_ids([3, 5])
+    assert len(idx.tombstones) == 0  # nothing recorded — drain stays safe
+
+
+def test_trained_unsupported_kind_rejects_buffered_only_delete(
+        tmp_path, rng):
+    """Same contract on a TRAINED engine: even when every matching row is
+    buffered (no device mask would happen in the call), an index instance
+    without remove_rows support rejects up front."""
+    from distributed_faiss_tpu.models import base
+
+    idx, x = build_engine(tmp_path, rng, n=30)
+
+    class Maskless(base.TpuIndex):
+        def __init__(self, inner):
+            super().__init__(inner.dim, inner.metric)
+            self._inner = inner
+
+        @property
+        def ntotal(self):
+            return self._inner.ntotal
+
+    with idx.index_lock:
+        idx.tpu_index = Maskless(idx.tpu_index)
+    with pytest.raises(RuntimeError, match="does not support remove"):
+        idx.remove_ids([0])
+    assert len(idx.tombstones) == 0
+
+
+def test_pretransform_delegates_tombstone_mask(rng):
+    """PCA/OPQ wrappers pass the positional mask through to the inner
+    index (the transform maps vectors, not row slots)."""
+    from distributed_faiss_tpu.models.pretransform import PreTransformIndex
+
+    x = rng.standard_normal((80, DIM)).astype(np.float32)
+    inner = FlatIndex(8, "l2")
+    idx = PreTransformIndex(inner, DIM, pca=True)
+    idx.train(x)
+    idx.add(x)
+    assert idx.supports_remove_rows()
+    idx.remove_rows(np.arange(10))
+    _, i = idx.search(x[:5], 8)
+    assert not np.intersect1d(np.asarray(i).ravel(), np.arange(10)).size
+
+
+def test_drain_rejection_matches_failover_classifier(tmp_path, rng):
+    """The replicated read path's drain-failover matcher is built from the
+    SAME format string the engine raises with — this pins the two against
+    drift (a reword used to silently disable failover)."""
+    from distributed_faiss_tpu.parallel import replication, rpc
+    from distributed_faiss_tpu.utils.state import IndexState
+
+    idx, x = build_engine(tmp_path, rng, n=30)
+    with idx.index_lock:
+        idx.state = IndexState.ADD
+    with pytest.raises(RuntimeError) as ei:
+        idx.search_batched(x[:2], 3)
+    assert replication.drain_failover_eligible(
+        rpc.ServerException(str(ei.value)))
+    with idx.index_lock:
+        idx.state = IndexState.TRAINED
+
+
+def test_sidecar_version_gate_keeps_last_writer_correct(tmp_path, rng):
+    """The sidecar write happens OUTSIDE the serving locks; the version
+    gate must drop a stale writer that lost the race to a newer payload
+    (the newer one is always a superset)."""
+    idx, x = build_engine(tmp_path, rng, n=40)
+    idx.remove_ids([0])
+    with idx.buffer_lock, idx.index_lock:
+        idx.tombstones.add([1], [(1,)])
+        p1, v1 = idx._tombstone_payload_locked()
+        idx.tombstones.add([2], [(2,)])
+        p2, v2 = idx._tombstone_payload_locked()
+    idx._write_tombstone_sidecar(p2, v2)   # newer lands first
+    idx._write_tombstone_sidecar(p1, v1)   # stale writer must skip
+    side = tombstones.load_sidecar(idx.cfg.index_storage_dir)
+    assert set(side["dead_rows"]) == {0, 1, 2}
+
+
+def test_sidecar_still_durable_before_remove_returns(tmp_path, rng):
+    """Moving the fsync off the serving locks must not move it past the
+    ack: the sidecar on disk reflects the delete when remove_ids
+    returns."""
+    idx, x = build_engine(tmp_path, rng, n=40)
+    idx.remove_ids([4, 7])
+    side = tombstones.load_sidecar(idx.cfg.index_storage_dir)
+    assert {4, 7} <= set(side["dead_rows"])
+
+
+def test_sharded_remove_rows_masks_across_the_mesh(rng):
+    """The sharded ids-plane mask (ShardedPaddedLists.mask_cells) splits
+    the flat cell address into (chip, local position) host-side in int64
+    — a global address over a big padded plane can exceed int32, and a
+    silent wrap used to drop the delete on device."""
+    from distributed_faiss_tpu.parallel.mesh import (
+        ShardedIVFFlatIndex,
+        make_mesh,
+    )
+
+    x = rng.standard_normal((600, DIM)).astype(np.float32)
+    idx = ShardedIVFFlatIndex(DIM, 8, "l2", mesh=make_mesh(8))
+    idx.train(x)
+    idx.add(x)
+    _, i0 = idx.search(x[:10], 5)
+    assert (np.asarray(i0)[:, 0] == np.arange(10)).all()
+    idx.remove_rows(np.arange(10))
+    _, i1 = idx.search(x[:10], 5)
+    assert not np.intersect1d(np.asarray(i1).ravel(), np.arange(10)).size

@@ -1,0 +1,222 @@
+"""Mutation acceptance gate (ISSUE 9): on an R=2 replicated cluster under
+a live mux query storm, delete 30% of one group's ids, trigger
+compaction, SIGKILL the compacting rank mid-pass — no deleted id may ever
+appear in any storm result, the rank must restart on the pre-compaction
+generation with tombstones intact, and post-restart results must be
+byte-identical to a freshly built index over the surviving rows."""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_faiss_tpu.models.flat import FlatIndex
+from distributed_faiss_tpu.parallel.client import IndexClient
+from distributed_faiss_tpu.testing.chaos import QueryStorm, ServerHarness
+from distributed_faiss_tpu.utils import serialization
+from distributed_faiss_tpu.utils.config import IndexCfg, ReplicationCfg
+from distributed_faiss_tpu.utils.state import IndexState
+
+pytestmark = [pytest.mark.mutation, pytest.mark.chaos, pytest.mark.slow]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# DFT_COMPACT=0: the gate triggers compaction explicitly (compact_index)
+# so the SIGKILL lands deterministically inside the widened mid-pass
+# window (DFT_COMPACT_TEST_DELAY_S)
+ENV = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT,
+       "DFT_COMPACT": "0", "DFT_COMPACT_TEST_DELAY_S": "4.0"}
+
+DIM = 16
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def flat_cfg():
+    return IndexCfg(index_builder_type="flat", dim=DIM, metric="l2",
+                    train_num=50)
+
+
+def wait_drained(client, index_id, n, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if (client.get_state(index_id) == IndexState.TRAINED
+                and client.get_buffer_depth(index_id) == 0
+                and client.get_ntotal(index_id) >= n):
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"cluster never drained to {n} indexed rows")
+
+
+def test_sigkill_mid_compaction_under_storm_gate(tmp_path):
+    """The gate, end to end:
+
+    1. healthy R=2 cluster (4 ranks, 2 groups), 300 rows ingested + saved;
+    2. delete 30% of group 0's ids cluster-wide (quorum delete);
+    3. golden = post-delete search; verified byte-identical against a
+       freshly built local index over the surviving rows;
+    4. 4-thread mux query storm; trigger compaction on one group-0 replica
+       and SIGKILL it inside the pass (before its commit point);
+    5. zero storm errors, every storm result byte-identical to golden, no
+       deleted id in any result (failover + the peer's tombstones);
+    6. restart the victim from storage: it comes back on the
+       PRE-compaction generation with tombstones intact (sidecar), pinned
+       reads serve golden again on the same client.
+    """
+    disc = str(tmp_path / "disc.txt")
+    storage = str(tmp_path / "storage")
+    with ServerHarness(4, disc, storage, base_port=free_port(), env=ENV) as h:
+        client = IndexClient(
+            disc, replication_cfg=ReplicationCfg(replication=2,
+                                                 write_quorum=1))
+        client.create_index("gidx", flat_cfg())
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((300, DIM)).astype(np.float32)
+        for s in range(0, 300, 50):
+            client.add_index_data("gidx", x[s:s + 50],
+                                  [(i,) for i in range(s, s + 50)])
+        wait_drained(client, "gidx", 300)
+        client.save_index("gidx")
+
+        # ---- delete 30% of ONE group's ids (cluster-wide quorum delete)
+        group = 0
+        g0_pos = client.membership.replicas(group)[0]
+        g0_ids = sorted(client.sub_indexes[g0_pos].generic_fun(
+            "get_ids", ("gidx",)))
+        doomed = g0_ids[: max(1, int(0.3 * len(g0_ids)))]
+        removed = client.remove_ids("gidx", doomed)
+        assert removed == len(doomed)
+        dead_meta = {(i,) for i in doomed}
+
+        # ---- golden AND the freshly-built-over-survivors oracle
+        q = np.ascontiguousarray(x[:8])
+        g_scores, g_meta = client.search(q, 5, "gidx")
+        survivors = [i for i in range(300) if i not in set(doomed)]
+        fresh = FlatIndex(DIM, "l2")
+        fresh.train(x)
+        fresh.add(x[survivors])
+        f_scores, f_ids = fresh.search(q, 5)
+        np.testing.assert_array_equal(g_scores, f_scores)
+        assert g_meta == [[(survivors[j],) for j in row]
+                          for row in f_ids.tolist()]
+
+        # ---- storm + compaction + SIGKILL mid-pass
+        victim_pos = g0_pos
+        victim_rank = client.sub_indexes[victim_pos].port - h.base_port
+        victim_dir = os.path.join(storage, "gidx", str(victim_rank))
+        gens_before = serialization.list_generations(victim_dir)
+        assert gens_before, "victim never committed its save"
+
+        compact_err = []
+
+        def trigger_compaction():
+            try:
+                client.sub_indexes[victim_pos].generic_fun(
+                    "compact_index", ("gidx",), timeout=30.0)
+            except Exception as e:  # the kill lands mid-call: expected
+                compact_err.append(e)
+
+        with QueryStorm(client, "gidx", q, 5, threads=4) as storm:
+            time.sleep(0.7)  # storm baseline against the healthy cluster
+            t = threading.Thread(target=trigger_compaction, daemon=True)
+            t.start()
+            time.sleep(1.5)  # compaction is inside its (4s) mid-pass window
+            h.kill(victim_rank)
+            time.sleep(1.5)  # storm keeps running against the outage
+        results, errors = storm.stop()
+
+        assert errors == [], f"storm saw search errors: {errors[:3]}"
+        assert len(results) >= 10, "storm produced too few samples"
+        for scores, meta in results:
+            np.testing.assert_array_equal(scores, g_scores)
+            assert meta == g_meta
+            assert not any(m in dead_meta for row in meta for m in row)
+
+        # ---- the killed compaction never committed a generation
+        assert (serialization.list_generations(victim_dir)[0][0]
+                == gens_before[0][0])
+
+        # ---- restart from storage: pre-compaction generation + sidecar
+        h.restart(victim_rank, load_index=False,
+                  extra_env={"DFT_SHARD_GROUP": str(group)})
+        h.wait_port(victim_rank)
+        deadline = time.time() + 60
+        while True:
+            try:
+                assert client.sub_indexes[victim_pos].generic_fun(
+                    "load_index", ("gidx", None), timeout=30.0)
+                stats = client.sub_indexes[victim_pos].generic_fun(
+                    "get_perf_stats", timeout=10.0)
+                if stats["mutation"]["gidx"]["tombstoned_rows"] \
+                        == len(doomed):
+                    break
+            except AssertionError:
+                raise
+            except Exception:
+                pass
+            assert time.time() < deadline, "victim never restored tombstones"
+            time.sleep(0.3)
+        mu = stats["mutation"]["gidx"]
+        assert mu["compactions"] == 0  # it restarted PRE-compaction
+        assert mu["live_fraction"] == pytest.approx(
+            1.0 - len(doomed) / len(g0_ids))
+
+        # pinned reads on the restarted rank: byte-identical to golden ==
+        # byte-identical to the freshly built index over survivors
+        with client._stats_lock:
+            client._preferred[group] = victim_pos
+        scores2, meta2 = client.search(q, 5, "gidx")
+        np.testing.assert_array_equal(scores2, g_scores)
+        assert meta2 == g_meta
+        served = client.sub_indexes[victim_pos].generic_fun("get_perf_stats")
+        assert served.get("search", {}).get("count", 0) >= 1, (
+            "pinned search was not served by the restarted rank")
+        client.close()
+
+
+def test_compaction_commits_and_serves_identically_under_storm(tmp_path):
+    """The non-crash half: a compaction that RUNS TO COMMIT under a live
+    storm changes no result byte and reclaims the tombstones."""
+    disc = str(tmp_path / "disc.txt")
+    storage = str(tmp_path / "storage")
+    env = dict(ENV, DFT_COMPACT_TEST_DELAY_S="0.5")
+    with ServerHarness(2, disc, storage, base_port=free_port(), env=env):
+        client = IndexClient(disc, replication_cfg=ReplicationCfg())
+        client.create_index("cidx", flat_cfg())
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((200, DIM)).astype(np.float32)
+        for s in range(0, 200, 50):
+            client.add_index_data("cidx", x[s:s + 50],
+                                  [(i,) for i in range(s, s + 50)])
+        wait_drained(client, "cidx", 200)
+        client.save_index("cidx")
+        client.remove_ids("cidx", list(range(0, 60)))
+        q = np.ascontiguousarray(x[100:108])
+        g_scores, g_meta = client.search(q, 5, "cidx")
+
+        with QueryStorm(client, "cidx", q, 5, threads=4) as storm:
+            time.sleep(0.3)
+            outcomes = client.compact_index("cidx")
+            time.sleep(0.5)
+        results, errors = storm.stop()
+        assert errors == []
+        assert any(outcomes)  # ranks holding tombstones compacted
+        for scores, meta in results:
+            np.testing.assert_array_equal(scores, g_scores)
+            assert meta == g_meta
+        # post-compaction: same bytes, tombstones reclaimed
+        scores2, meta2 = client.search(q, 5, "cidx")
+        np.testing.assert_array_equal(scores2, g_scores)
+        assert meta2 == g_meta
+        for entry in client.get_perf_stats():
+            mu = entry["mutation"]["cidx"]
+            assert mu["tombstoned_rows"] == 0 or mu["compactions"] >= 1
+        client.close()

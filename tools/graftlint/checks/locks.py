@@ -79,6 +79,21 @@ PINS = {
     # appended by N storm threads and drained by stop()
     ("QueryStorm", "results"): "_lock",
     ("QueryStorm", "errors"): "_lock",
+    # mutation subsystem (engine.py + mutation/): the tombstone set rides
+    # index_lock — the SAME lock every device search and the mask scatter
+    # hold, which is the no-torn-mask-mid-window guarantee; the metadata
+    # layout epoch (compaction-swap seqlock) rides buffer_lock, the join
+    # side. The compaction watcher thread (mutation/compaction.py
+    # run_watcher) takes only these pinned engine locks.
+    ("Index", "tombstones"): "index_lock",
+    ("Index", "_mutation_counters"): "index_lock",
+    ("Index", "_meta_epoch"): "buffer_lock",
+    # standalone-sidecar writer: payload versions are assigned under
+    # index_lock (with the set mutation); the disk write + written-version
+    # watermark ride a dedicated leaf lock so a delete storm's fsyncs
+    # never stall the serving locks
+    ("Index", "_tombstone_version"): "index_lock",
+    ("Index", "_tombstone_written"): "_tombstone_io_lock",
 }
 
 _SKIP_METHODS = frozenset({"__init__", "__new__", "__del__"})
