@@ -36,6 +36,7 @@ _LAZY = {
     "SchedulerCfg": ("distributed_faiss_tpu.utils.config", "SchedulerCfg"),
     "MeshCfg": ("distributed_faiss_tpu.utils.config", "MeshCfg"),
     "ReplicationCfg": ("distributed_faiss_tpu.utils.config", "ReplicationCfg"),
+    "AntiEntropyCfg": ("distributed_faiss_tpu.utils.config", "AntiEntropyCfg"),
     "QuorumError": ("distributed_faiss_tpu.parallel.client", "QuorumError"),
     "MembershipTable": ("distributed_faiss_tpu.parallel.replication",
                         "MembershipTable"),

@@ -21,6 +21,7 @@ same for FAISS, index.py:246-252).
 """
 
 import _thread
+import hashlib
 import logging
 import os
 import pickle
@@ -126,16 +127,41 @@ class _MetaStore:
         return self._arr[: self._n].tolist()
 
 
-def _id_match_key(v):
-    """Normalize a metadata id for cross-layout sidecar matching: JSON
-    round-trips tuples as lists and stringifies everything it can't
-    serialize, so both sides reduce to (recursively) tuple-ized values or
-    their str() as the last resort."""
-    if isinstance(v, (list, tuple)):
-        return tuple(_id_match_key(e) for e in v)
-    if isinstance(v, (int, float, str, bool)):
-        return v
-    return str(v)
+# normalized id keys for cross-layout / cross-replica matching — shared
+# with the anti-entropy digest machinery (mutation/tombstones.py)
+_id_match_key = _tombstones.id_match_key
+
+# commutative digest arithmetic: per-id 128-bit hashes summed mod 2^128,
+# so the digest is independent of row insertion order (reroutes and repair
+# re-sends interleave differently per replica) and a multiset of ids —
+# unlike XOR — cannot cancel a duplicated id pair out
+_DIGEST_MASK = (1 << 128) - 1
+
+
+def _id_hash(key) -> int:
+    return int.from_bytes(
+        hashlib.sha1(
+            repr(key).encode("utf-8", "backslashreplace")).digest()[:16],
+        "little")
+
+
+def _iter_live_ids(meta_arr, meta_n: int, dead_rows, id_idx: int):
+    """Yield ``(position, raw_id, meta)`` for every LIVE metadata row: the
+    one scan the anti-entropy surfaces (replica_digest, id_sets,
+    export_rows, reconcile_deletes) all share, so the live-row rule —
+    skip falsy rows, skip tombstoned positions, skip rows whose metadata
+    cannot yield an id — cannot drift between digest contents and delta
+    contents (a one-sided drift shows up as a permanent
+    digests_mismatched loop the sweep can never heal)."""
+    for p in range(meta_n):
+        m = meta_arr[p]
+        if not m or p in dead_rows:
+            continue
+        try:
+            mid = m[id_idx]
+        except (TypeError, IndexError, KeyError):
+            continue
+        yield p, mid, m
 
 
 def _apply_sidecar_by_id(tomb: "TombstoneSet", side: dict, meta: list,
@@ -252,6 +278,17 @@ class Index:
         # its metadata join instead of joining old ids to new metadata.
         # Guarded by buffer_lock (the join side).
         self._meta_epoch = 0
+        # cached replica digest (parallel/antientropy.py): recomputed only
+        # when the cache key — (meta epoch, tombstone version, metadata
+        # length), i.e. any mutation or generation bump — moves. Guarded
+        # by index_lock (read/written under both engine locks).
+        self._digest_cache = None
+        # cross-replica compaction lease hook: the server's anti-entropy
+        # sweeper installs a callable returning True while THIS rank holds
+        # its group's compaction token; None (standalone/unreplicated
+        # engines) means the background watcher compacts freely. The
+        # explicit compact_index op is never gated — operator override.
+        self.compaction_gate = None
         self.mutation_cfg = MutationCfg.from_env()
         if self.mutation_cfg.compact and cfg.index_storage_dir:
             self._run_compaction_watcher()
@@ -299,6 +336,30 @@ class Index:
             self.id_to_metadata.extend(metadata)
             self.total_data += n
             total_data = self.total_data
+
+        # a re-added id is live again: drop its deletion-ledger entry so
+        # anti-entropy can replicate the re-add (upsert semantics). O(batch)
+        # hash lookups, and only when a delete ever happened here. The
+        # unledger must be DURABLE like the delete it reverses: a restart
+        # re-reads the sidecar, and a stale ledger entry would let a
+        # peer's delete-wins sweep re-delete the acked re-add cluster-wide
+        payload = None
+        with self.index_lock:
+            if self.tombstones.ledger_size():
+                id_idx = self.cfg.custom_meta_id_idx
+                keys = []
+                for m in metadata:
+                    if not m:
+                        continue
+                    try:
+                        keys.append(m[id_idx])
+                    except (TypeError, IndexError, KeyError):
+                        continue
+                if self.tombstones.unledger(keys):
+                    self._digest_cache = None
+                    payload, version = self._tombstone_payload_locked()
+        if payload is not None:
+            self._write_tombstone_sidecar(payload, version)
 
         state = self.get_state()
         if state == IndexState.TRAINED:
@@ -497,6 +558,148 @@ class Index:
             out["compaction_s"] = comp
         return out
 
+    # ----------------------------------------------------------- anti-entropy
+
+    def replica_digest(self) -> dict:
+        """Cheap, order-independent convergence digest for server-side
+        anti-entropy (parallel/antientropy.py).
+
+        ``live_hash`` is a commutative sum (mod 2^128) of per-id hashes
+        over every live metadata id — buffered rows included, tombstoned
+        rows excluded — so two replicas that hold the same logical rows in
+        DIFFERENT insertion orders (reroutes, repair re-sends) digest
+        identically; ``dead_hash`` covers the deletion ledger the same
+        way. Engine-local counters (tombstone version, layout epoch,
+        ntotal) deliberately stay OUT of the comparable digest — they
+        differ between converged replicas that compacted at different
+        times — and form the CACHE KEY instead: the digest is captured
+        under the engine locks and cached until the next mutation or
+        generation bump moves (meta epoch, tombstone version, metadata
+        length). The O(rows) hash runs outside the locks against the
+        append-only metadata snapshot (the search-join contract), so
+        sweeps never stall serving."""
+        with self.buffer_lock, self.index_lock:
+            key = (self._meta_epoch, self._tombstone_version,
+                   len(self.id_to_metadata))
+            if self._digest_cache is not None and self._digest_cache[0] == key:
+                return dict(self._digest_cache[1])
+            meta_arr, meta_n = self.id_to_metadata.snapshot()
+            dead_rows = frozenset(self.tombstones.rows())
+            ledger = self.tombstones.ledger()
+        id_idx = self.cfg.custom_meta_id_idx
+        live_sum, live_n = 0, 0
+        for _p, mid, _m in _iter_live_ids(meta_arr, meta_n, dead_rows, id_idx):
+            live_sum = (live_sum + _id_hash(_id_match_key(mid))) & _DIGEST_MASK
+            live_n += 1
+        dead_sum = 0
+        for k in ledger:
+            dead_sum = (dead_sum + _id_hash(k)) & _DIGEST_MASK
+        digest = {
+            "live_n": live_n,
+            "live_hash": format(live_sum, "032x"),
+            "dead_n": len(ledger),
+            "dead_hash": format(dead_sum, "032x"),
+        }
+        with self.buffer_lock, self.index_lock:
+            if key == (self._meta_epoch, self._tombstone_version,
+                       len(self.id_to_metadata)):
+                self._digest_cache = (key, dict(digest))
+        return digest
+
+    def id_sets(self) -> dict:
+        """Normalized id sets for the anti-entropy delta protocol:
+        ``live`` = every live metadata id (buffered included), ``dead`` =
+        the deletion ledger. Keys ride ``id_match_key`` normalization so
+        replicas whose persistence histories differ (JSON sidecar
+        round-trips turn tuples into lists) still compare equal."""
+        with self.buffer_lock, self.index_lock:
+            meta_arr, meta_n = self.id_to_metadata.snapshot()
+            dead_rows = frozenset(self.tombstones.rows())
+            ledger = self.tombstones.ledger()
+        id_idx = self.cfg.custom_meta_id_idx
+        live = [_id_match_key(mid) for _p, mid, _m
+                in _iter_live_ids(meta_arr, meta_n, dead_rows, id_idx)]
+        return {"live": live, "dead": sorted(ledger, key=repr)}
+
+    # graftlint: ok(blocking-under-lock): designed locked fetch — rows and their metadata must come from one atomic index state (repair path, never hot)
+    def export_rows(self, ids) -> Tuple[np.ndarray, list]:
+        """Rows for an anti-entropy delta pull: ``(embeddings, metadata)``
+        for every LIVE local row whose id is in ``ids``. One atomic
+        capture under both locks (positions must pair with the buffer
+        they index into); indexed rows come back via reconstruct (exact
+        for raw-storage kinds — flat/IVF-Flat; encoded kinds round-trip
+        through their codec, which is why large divergence on those
+        prefers the full-snapshot sync path), buffered rows verbatim."""
+        want = {_id_match_key(i) for i in ids}
+        with self.buffer_lock, self.index_lock:
+            meta_arr, meta_n = self.id_to_metadata.snapshot()
+            indexed_n = (self.tpu_index.ntotal
+                         if self.tpu_index is not None else 0)
+            dead_rows = frozenset(self.tombstones.rows())
+            id_idx = self.cfg.custom_meta_id_idx
+            positions, metas = [], []
+            for p, mid, m in _iter_live_ids(meta_arr, meta_n,
+                                            dead_rows, id_idx):
+                if _id_match_key(mid) in want:
+                    positions.append(p)
+                    metas.append(m)
+            dim = int(self.cfg.dim)
+            # the buffer concatenate is O(buffered rows) under both locks:
+            # pay it only when a wanted row is actually still buffered
+            # (post-drain — the common case — every hit is indexed)
+            need_buffer = any(p >= indexed_n for p in positions)
+            flat_buf = (np.concatenate(self.embeddings_buffer, axis=0)
+                        if need_buffer and self.embeddings_buffer
+                        else np.zeros((0, dim), np.float32))
+            out = np.zeros((len(positions), dim), np.float32)
+            keep = np.ones(len(positions), bool)
+            idxed = [(j, p) for j, p in enumerate(positions) if p < indexed_n]
+            if idxed:
+                rec = np.asarray(self.tpu_index.reconstruct_batch(
+                    np.asarray([p for _j, p in idxed], np.int64)), np.float32)
+                out[[j for j, _p in idxed]] = rec
+            for j, p in enumerate(positions):
+                if p < indexed_n:
+                    continue
+                off = p - indexed_n
+                if off < flat_buf.shape[0]:
+                    out[j] = flat_buf[off]
+                else:  # meta/buffer mismatch (legacy truncation): skip row
+                    keep[j] = False
+        if not keep.all():
+            out = out[keep]
+            metas = [m for j, m in enumerate(metas) if keep[j]]
+        return out, metas
+
+    def reconcile_deletes(self, dead_keys) -> int:
+        """Apply a peer's deletion ledger: tombstone every live local row
+        whose id the peer has deleted (delete-wins — the documented
+        conservative rule: a delete must never resurrect; re-ingest
+        restores an upsert), and record EVERY peer key in the local
+        ledger — durable before return, like any delete — so a stale
+        repair re-send can never be pulled back by a later sweep.
+        Returns the rows newly tombstoned."""
+        keys = {_id_match_key(k) for k in dead_keys}
+        if not keys:
+            return 0
+        with self.buffer_lock, self.index_lock:
+            meta_arr, meta_n = self.id_to_metadata.snapshot()
+            dead_rows = frozenset(self.tombstones.rows())
+        id_idx = self.cfg.custom_meta_id_idx
+        raw = [mid for _p, mid, _m
+               in _iter_live_ids(meta_arr, meta_n, dead_rows, id_idx)
+               if _id_match_key(mid) in keys]
+        removed = self.remove_ids(raw) if raw else 0
+        with self.buffer_lock, self.index_lock:
+            if self.tombstones.ledger_update(keys):
+                self._digest_cache = None
+                payload, version = self._tombstone_payload_locked()
+            else:
+                payload = None
+        if payload is not None:
+            self._write_tombstone_sidecar(payload, version)
+        return removed
+
     def compact(self) -> bool:
         """Rewrite tombstoned rows out of the index as a fresh MANIFEST
         generation, swapped in atomically. Returns True when a compaction
@@ -582,6 +785,10 @@ class Index:
                 elif keep[p]:
                     carried[int(old2new[p])] = mid
             new_tomb = TombstoneSet(carried)
+            # the deletion ledger is position-free and must SURVIVE the
+            # swap: compaction reclaims rows, never forgets that their
+            # ids were deleted (the anti-entropy resurrect guard)
+            new_tomb.ledger_update(self.tombstones.ledger())
             if any(r < new_n for r in carried):
                 # graftlint: ok(blocking-under-lock): locked mask scatter (tombstone consistency contract)
                 new_index.remove_rows(np.asarray(

@@ -352,6 +352,7 @@ class _IVFBase(base.TpuIndex):
         chunk = kmeans.auto_chunk(self.nlist, chunk)
         out = np.empty(x.shape[0], np.int64)
         for s in range(0, x.shape[0], chunk):
+            # graftlint: ok(host-sync): designed chunked host fetch — assignments land in a preallocated host buffer; chunking exists to bound the (chunk, nlist) device transient (ingest path, reached from search only via name-collision propagation)
             out[s : s + chunk] = np.asarray(
                 _coarse_assign(self.centroids, jnp.asarray(x[s : s + chunk]), self.metric)
             )
@@ -542,6 +543,7 @@ class IVFFlatIndex(_IVFBase):
             r = jnp.asarray(rows[s:s + chunk])
             if self.codec == "sq8":
                 r = sq.sq8_decode(r, self.sq_params["vmin"], self.sq_params["span"])
+            # graftlint: ok(host-sync): designed chunked host fetch — norms land in a preallocated host buffer; the chunking bounds the decode transient (~300 GB unchunked at rehearsal scale; save/backfill path, not serving)
             out[s:s + chunk] = np.asarray(base.row_norms_f32(r))
         return out
 

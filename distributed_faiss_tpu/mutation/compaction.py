@@ -86,6 +86,20 @@ def run_watcher(engine, cfg) -> None:
             frac = engine.tombstone_fraction()
             if frac < cfg.threshold:
                 continue
+            # cross-replica compaction lease (parallel/antientropy.py):
+            # when the server's sweeper installed a gate, only the rank
+            # holding its group's compaction token passes — so the R
+            # replicas of a group never pay the double-compaction p99
+            # window by passing at once. The explicit compact_index op
+            # bypasses this (operator override); standalone engines have
+            # no gate and compact freely.
+            gate = engine.compaction_gate
+            if gate is not None and not gate():
+                logger.debug(
+                    "compaction watcher (%s): tombstone fraction %.3f but "
+                    "another replica holds the group's compaction lease — "
+                    "deferring", name, frac)
+                continue
             logger.info(
                 "compaction watcher (%s): tombstone fraction %.3f >= %.3f, "
                 "compacting", name, frac, cfg.threshold)

@@ -41,20 +41,60 @@ SIDECAR_NAME = "tombstones.json"
 PAYLOAD_FORMAT = 1
 
 
+def id_match_key(v):
+    """Normalize a metadata id for cross-layout / cross-replica matching:
+    JSON round-trips tuples as lists and stringifies everything it can't
+    serialize, so both sides reduce to (recursively) tuple-ized values or
+    their str() as the last resort. Shared by the sidecar BY-ID recovery
+    (engine._apply_sidecar_by_id) and the anti-entropy digest/delta
+    machinery (parallel/antientropy.py), which compare id SETS across
+    replicas whose persistence histories differ."""
+    if isinstance(v, (list, tuple)):
+        return tuple(id_match_key(e) for e in v)
+    if isinstance(v, (int, float, str, bool)):
+        return v
+    return str(v)
+
+
 class TombstoneSet:
     """Positional dead-row set with the id-keyed record riding along.
 
     Plain data — thread-safety is the owning engine's ``index_lock``
     (copy what you need under the lock before iterating outside it).
+
+    Besides the positional ``row -> id`` map (which compaction clears as
+    it reclaims the rows), the set carries a position-free **deletion
+    ledger**: the normalized ``id_match_key`` of every id ever deleted on
+    this shard, surviving compaction and persisted in every sidecar
+    payload. The ledger is what lets server-side anti-entropy
+    (parallel/antientropy.py) distinguish "the peer is missing this row"
+    from "this row was deleted here" — without it, a sweep against a
+    compacted replica would resurrect deleted ids. A legal re-add of a
+    deleted id (upsert) removes its ledger entry (engine.add_batch), so
+    delete-then-readd converges to live everywhere.
     """
 
-    __slots__ = ("_rows", "layout")
+    __slots__ = ("_rows", "layout", "_ledger")
 
     def __init__(self, rows: Optional[Dict[int, object]] = None,
-                 layout: int = 0):
+                 layout: int = 0, ledger=None,
+                 seed_ledger_from_rows: bool = True):
         self._rows: Dict[int, object] = (
             {int(r): v for r, v in rows.items()} if rows else {})
         self.layout = int(layout)
+        self._ledger = {id_match_key(k) for k in ledger} if ledger else set()
+        # seed the ledger from the positional dead ids: right for direct
+        # construction (a dead row's id was deleted) and for PRE-ledger
+        # payloads — but a payload that CARRIES a dead_ledger is
+        # authoritative and must not be re-seeded (from_payload): a
+        # re-added (upserted) id is unledgered while its old positional
+        # row stays dead until compaction, and re-seeding from that row
+        # would resurrect the ledger entry on every reload, letting a
+        # peer's delete-wins sweep destroy the live upsert cluster-wide
+        if seed_ledger_from_rows:
+            for v in self._rows.values():
+                if v is not None:
+                    self._ledger.add(id_match_key(v))
 
     def __len__(self) -> int:
         return len(self._rows)
@@ -82,6 +122,38 @@ class TombstoneSet:
             return
         for r, i in zip(rows, ids):
             self._rows[int(r)] = i
+            if i is not None:
+                self._ledger.add(id_match_key(i))
+
+    # ------------------------------------------------------ deletion ledger
+
+    def ledger(self) -> frozenset:
+        """Normalized keys of every id ever deleted on this shard (copy —
+        safe outside the lock). Survives compaction; the anti-entropy
+        pull guard."""
+        return frozenset(self._ledger)
+
+    def ledger_size(self) -> int:
+        return len(self._ledger)
+
+    def ledger_update(self, keys: Iterable) -> int:
+        """Record peer-observed deletions (already-normalized keys or raw
+        ids). Returns how many keys were new."""
+        before = len(self._ledger)
+        for k in keys:
+            self._ledger.add(id_match_key(k))
+        return len(self._ledger) - before
+
+    def unledger(self, keys: Iterable) -> int:
+        """Drop ledger entries for ids that were legally re-added (upsert
+        visibility: a re-ingested id must become pullable again)."""
+        hit = 0
+        for k in keys:
+            kk = id_match_key(k)
+            if kk in self._ledger:
+                self._ledger.discard(kk)
+                hit += 1
+        return hit
 
     def count_below(self, n: int) -> int:
         """Dead rows with position < n (i.e. already indexed rows)."""
@@ -98,6 +170,9 @@ class TombstoneSet:
             "layout": self.layout,
             "dead_rows": rows,
             "dead_ids": [self._rows[r] for r in rows],
+            # position-free: survives compaction and layout swaps; JSON
+            # round-trips tuples as lists, re-normalized at load
+            "dead_ledger": sorted(self._ledger, key=repr),
         }
 
     @classmethod
@@ -109,7 +184,12 @@ class TombstoneSet:
         mapping = dict.fromkeys(rows)
         for r, i in zip(rows, ids):
             mapping[r] = i
-        return cls(mapping, layout=int(payload.get("layout", 0)))
+        return cls(mapping, layout=int(payload.get("layout", 0)),
+                   ledger=payload.get("dead_ledger", ()),
+                   # a payload that carries the ledger key is
+                   # authoritative (even when empty) — only pre-ledger
+                   # payloads seed from dead_ids
+                   seed_ledger_from_rows="dead_ledger" not in payload)
 
     def merge_payload(self, payload: Optional[dict]) -> None:
         """Union another payload's rows in (same-layout sidecar merge)."""
@@ -118,6 +198,7 @@ class TombstoneSet:
         other = TombstoneSet.from_payload(payload)
         for r, i in other._rows.items():
             self._rows.setdefault(r, i)
+        self._ledger |= other._ledger
 
     def __repr__(self) -> str:
         return f"<TombstoneSet {len(self._rows)} dead, layout {self.layout}>"

@@ -1,0 +1,492 @@
+"""Server-side anti-entropy: replica digests, peer repair, health, leases.
+
+Through PR 8/9 convergence of a replica group was entirely CLIENT-driven:
+a write that landed below quorum is only healed if some client later
+calls ``repair_under_replicated()``, and records evicted from the bounded
+``RepairQueue`` (the ``dropped`` counter) were lost forever — a group
+could stay silently divergent until an operator noticed. This module
+closes that gap server-side. Each rank runs one named, tracked sweeper
+thread that:
+
+1. computes a cheap per-index **replica digest** (engine.replica_digest:
+   an order-independent hash over live metadata ids + the deletion
+   ledger, cached until the next mutation/generation bump);
+2. exchanges digests with its **group peers** — group known since PR 8
+   (``DFT_SHARD_GROUP`` / the ``set_shard_group`` registration op), peer
+   addresses resolved from the discovery file — over the lightweight
+   ``KIND_DIGEST``/``KIND_DIGEST_RESP`` frame pair, served on the
+   server's worker pool;
+3. on mismatch, **heals by pulling**: applies the peer's deletion ledger
+   first (delete-wins — anti-entropy can NEVER resurrect a deleted id),
+   then fetches the rows it is missing — an id-set delta
+   (``get_id_sets``/``export_rows`` ops) when divergence is small,
+   falling back to the existing full-snapshot ``KIND_SHARD_FETCH`` path
+   (``sync_shard_from`` → ``Index.import_snapshot``, committed through
+   the shared ``_commit_generation`` protocol) when it is large or the
+   peer also serves an index this rank lacks entirely;
+4. doubles as the **failure detector**: digest round-trips are
+   heartbeats; ``suspect_after`` consecutive failures mark a peer
+   suspect in the rank's :class:`HealthTable`, surfaced through the
+   ``get_health`` op and ``get_perf_stats["antientropy"]`` — clients
+   consult it to pre-skip suspect replicas in the read-failover walk
+   (``IndexClient.refresh_health``);
+5. carries the per-group **compaction lease**: the lowest LIVE rank of a
+   group (liveness window = ``lease_ttl_s``) holds the token, and the
+   background compaction watcher defers everywhere else
+   (``Index.compaction_gate``) — closing the p99-doubling window when
+   both replicas of a group compact at once. The explicit
+   ``compact_index`` op bypasses the lease (operator override).
+
+Pull-only by design: a sweep never pushes rows into a peer, so the worst
+a confused rank can do is fetch — each side pulls what IT is missing and
+the pair converges from both directions. Conflict rule (the repo's
+documented conservative precedent, see ``engine._apply_sidecar_by_id``):
+**delete wins** — an upsert's re-add racing anti-entropy against a
+replica that only saw the delete can be re-deleted until re-ingested;
+per-id versions for true last-writer-wins are future work (ROADMAP).
+Content divergence under an unchanged id (an in-place upsert the digest
+cannot see) is likewise healed by the quorum write path, not the sweep.
+
+Locks ride the lockdep factories and are pinned in graftlint's PINS map;
+no lock is ever held across socket I/O or an engine call (lock-order /
+blocking-under-lock checkers + the DFT_LOCKDEP witness cover it).
+"""
+
+import logging
+import socket as socketmod
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from distributed_faiss_tpu.mutation.tombstones import id_match_key
+from distributed_faiss_tpu.parallel import replication, rpc
+from distributed_faiss_tpu.utils import lockdep
+from distributed_faiss_tpu.utils.config import AntiEntropyCfg
+
+logger = logging.getLogger()
+
+# hosts that mean "this machine" when paired with our own bound port —
+# how a sweeper recognizes (and skips) its own discovery entry
+_SELF_HOSTS = frozenset({"localhost", "127.0.0.1", "::1",
+                         socketmod.gethostname()})
+
+# rows per export_rows RPC during a delta repair: bounds a single frame
+# (~1 MB of f32 at dim=128), not the total (a divergence larger than
+# delta_max_rows that cannot full-sync safely is pulled in chunks of
+# this size). Each chunk costs the donor an O(meta) id scan under its
+# engine locks, so the chunk is sized to keep that scan count low
+_DELTA_CHUNK = 2048
+
+# per-call socket deadline for the heal RPCs (get_id_sets, export_rows):
+# looser than the digest heartbeat deadline — get_id_sets is O(rows) on
+# the peer — but still BOUNDED, so a peer that goes silent mid-heal can
+# never wedge the sweeper thread (stop()'s join relies on every dial
+# being bounded)
+_HEAL_CALL_TIMEOUT_S = 30.0
+
+# a peer skipped for belonging to another group is still re-probed every
+# this-many sweeps: group registration can postdate the first exchange
+# (set_shard_group arrives with the first IndexClient), so a cached group
+# must never wedge a genuine peer out of the sweep forever
+_GROUP_REFRESH_SWEEPS = 10
+
+
+def read_peers(discovery_path: str) -> List[Tuple[str, int]]:
+    """Discovery-file entries as (host, port) pairs, deduped in
+    registration order (the shared ``replication.parse_discovery_lines``
+    parser). Missing/empty/garbled files degrade to [] — the sweeper just
+    idles until ranks register (it must never crash a serving process
+    over a half-written discovery file)."""
+    try:
+        with open(discovery_path) as f:
+            return replication.parse_discovery_lines(f)[1]
+    except OSError:
+        return []
+
+
+def digests_match(mine: Optional[dict], theirs: Optional[dict]) -> bool:
+    """Convergence comparison: the LIVE side only. Dead-side fields
+    (ledger hash/count) are informational — ledgers legitimately differ
+    between converged replicas (a delete for an id a replica never held
+    records nothing there), so comparing them would mismatch forever."""
+    if not isinstance(mine, dict) or not isinstance(theirs, dict):
+        return False
+    return (mine.get("live_n") == theirs.get("live_n")
+            and mine.get("live_hash") == theirs.get("live_hash"))
+
+
+class HealthTable:
+    """Per-rank failure-detector state: one entry per contacted peer
+    address, plus an inbound-contact map (peers whose sweeps reached us —
+    liveness evidence even when our own probes fail). Thread-safe; all
+    reads snapshot under the lock and never hold it across I/O."""
+
+    def __init__(self):
+        self._lock = lockdep.lock("HealthTable._lock")
+        self._peers: Dict[Tuple[str, int], dict] = {}
+        self._inbound: Dict[int, dict] = {}
+
+    def known_group(self, host: str, port: int):
+        """(known, group) for a peer address — known only after one
+        successful exchange; group may legitimately be None."""
+        with self._lock:
+            e = self._peers.get((host, port))
+            if e is None or not e.get("known"):
+                return False, None
+            return True, e.get("group")
+
+    def note_ok(self, addr: Tuple[str, int], rank, group) -> None:
+        now = time.monotonic()
+        with self._lock:
+            e = self._peers.setdefault(tuple(addr), {})
+            was_suspect = e.get("suspect", False)
+            e.update(known=True, rank=rank, group=group, failures=0,
+                     suspect=False, last_ok=now, last_error=None)
+        if was_suspect:
+            logger.info("anti-entropy: peer %s:%d (rank %s) recovered",
+                        addr[0], addr[1], rank)
+
+    def note_fail(self, addr: Tuple[str, int], suspect_after: int,
+                  exc: BaseException) -> bool:
+        """Record a failed round trip; returns True when this failure
+        crossed the suspect threshold."""
+        with self._lock:
+            e = self._peers.setdefault(tuple(addr), {})
+            e["failures"] = e.get("failures", 0) + 1
+            e["last_error"] = f"{type(exc).__name__}: {exc}"
+            newly = (not e.get("suspect", False)
+                     and e["failures"] >= suspect_after)
+            if newly:
+                e["suspect"] = True
+        if newly:
+            logger.warning(
+                "anti-entropy: peer %s:%d suspect after %d consecutive "
+                "failed digest round-trips (%s)", addr[0], addr[1],
+                suspect_after, exc)
+        return newly
+
+    def note_inbound(self, rank, group) -> None:
+        """A peer's sweep reached us: inbound liveness evidence (feeds
+        leader election even before our own probe succeeds)."""
+        if rank is None:
+            return
+        with self._lock:
+            self._inbound[int(rank)] = {"group": group,
+                                        "t": time.monotonic()}
+
+    def alive_ranks(self, group, ttl_s: float) -> set:
+        """Ranks of ``group`` heard from (either direction) within the
+        lease TTL — the electorate for the compaction lease."""
+        now = time.monotonic()
+        out = set()
+        with self._lock:
+            for e in self._peers.values():
+                if (e.get("rank") is not None and e.get("group") == group
+                        and e.get("last_ok") is not None
+                        and now - e["last_ok"] <= ttl_s):
+                    out.add(int(e["rank"]))
+            for r, rec in self._inbound.items():
+                if rec.get("group") == group and now - rec["t"] <= ttl_s:
+                    out.add(int(r))
+        return out
+
+    def suspects(self) -> List[dict]:
+        with self._lock:
+            return [{"host": h, "port": p, "rank": e.get("rank"),
+                     "group": e.get("group"),
+                     "failures": e.get("failures", 0),
+                     "last_error": e.get("last_error")}
+                    for (h, p), e in sorted(self._peers.items())
+                    if e.get("suspect")]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {f"{h}:{p}": dict(e)
+                    for (h, p), e in sorted(self._peers.items())}
+
+
+class AntiEntropySweeper:
+    """One per IndexServer: the background digest/repair/lease thread.
+
+    ``sweep_once`` is the deterministic unit tests drive directly; the
+    thread just loops it on ``cfg.interval_s`` with the stop event as the
+    sleep. Counters: sweeps, digests_matched, digests_mismatched,
+    rows_repaired, full_syncs — served through
+    ``get_perf_stats["antientropy"]`` and the ``get_health`` op."""
+
+    def __init__(self, server, discovery_path: str,
+                 cfg: Optional[AntiEntropyCfg] = None):
+        self.server = server
+        self.discovery_path = discovery_path
+        self.cfg = cfg if cfg is not None else AntiEntropyCfg.from_env()
+        self.health = HealthTable()
+        self._lock = lockdep.lock("AntiEntropySweeper._lock")
+        self._counters = {"sweeps": 0, "digests_matched": 0,
+                          "digests_mismatched": 0, "rows_repaired": 0,
+                          "full_syncs": 0, "empty_deltas": 0}
+        self._last_empty_warn = float("-inf")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"antientropy:r{self.server.rank}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if (t is not None and t.is_alive()
+                and t is not threading.current_thread()):
+            t.join(timeout=10.0)
+
+    def _run(self) -> None:
+        # the stop event doubles as the sleep (save/compaction-watcher
+        # precedent): stop() wakes the sweeper immediately
+        while not self._stop.wait(self.cfg.interval_s):
+            try:
+                self.sweep_once()
+            except Exception:
+                # the sweeper must survive any single failed round — the
+                # next interval retries against fresh state
+                logger.exception("anti-entropy sweep failed (rank %d)",
+                                 self.server.rank)
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    # ------------------------------------------------------------- sweeping
+
+    def _is_self(self, host: str, port: int) -> bool:
+        sock = self.server.socket
+        if sock is None:
+            return False
+        try:
+            my_port = sock.getsockname()[1]
+        except OSError:
+            return False
+        return port == my_port and host in _SELF_HOSTS
+
+    def sweep_once(self) -> dict:
+        """One full round: re-assert compaction gates, exchange digests
+        with every (known- or unknown-group) peer, heal mismatches.
+        Returns a summary dict for tests/operators."""
+        server = self.server
+        my_group = server.shard_group
+        summary = {"contacted": 0, "skipped": 0, "failed": 0, "healed": []}
+        with self._lock:
+            refresh = self._counters["sweeps"] % _GROUP_REFRESH_SWEEPS == 0
+        with server.indexes_lock:
+            engines = dict(server.indexes)
+        for engine in engines.values():
+            # idempotent re-assert: engines created before the sweeper
+            # started (or restored by a load) get the lease gate too
+            engine.compaction_gate = self.may_compact
+        for host, port in read_peers(self.discovery_path):
+            if self._is_self(host, port):
+                continue
+            known, peer_group = self.health.known_group(host, port)
+            # only a CONCRETE different group skips — a cached None means
+            # the peer had not registered yet (set_shard_group arrives
+            # with the first client), so it must keep being dialed until
+            # its group is known; and even concrete skips are re-probed
+            # on refresh sweeps in case the peer was relaunched into a
+            # different group on the same port
+            if (not refresh and known and peer_group is not None
+                    and my_group is not None and peer_group != my_group):
+                summary["skipped"] += 1
+                continue  # another group's replica
+            try:
+                resp = rpc.digest_exchange(
+                    host, port,
+                    {"rank": server.rank, "group": my_group, "want": None},
+                    timeout=self.cfg.exchange_timeout_s)
+            except rpc.TRANSPORT_ERRORS + (rpc.ServerException,) as e:
+                self.health.note_fail((host, port), self.cfg.suspect_after, e)
+                summary["failed"] += 1
+                continue
+            peer_rank = resp.get("rank")
+            peer_group = resp.get("shard_group")
+            self.health.note_ok((host, port), peer_rank, peer_group)
+            summary["contacted"] += 1
+            if my_group is None or peer_group != my_group:
+                continue  # liveness only — digests compare within a group
+            peer_digests = resp.get("digests") or {}
+            for index_id, theirs in sorted(peer_digests.items()):
+                with server.indexes_lock:
+                    engine = server.indexes.get(index_id)
+                    dropped = index_id in server._dropped
+                if dropped:
+                    # this rank dropped the index: the peer's copy is a
+                    # missed drop broadcast, not state we are missing —
+                    # never pull it back (an explicit re-create/load/
+                    # resync clears the marker)
+                    continue
+                if engine is None:
+                    # the peer serves an index this rank lacks entirely
+                    # (restarted empty): stream it whole — the full-sync
+                    # path commits a MANIFEST generation on our disk
+                    try:
+                        server.sync_shard_from(index_id, host, port)
+                        self._bump("full_syncs")
+                        summary["healed"].append(
+                            {"index_id": index_id, "peer": (host, port),
+                             "full_sync": True})
+                    except Exception:
+                        logger.exception(
+                            "anti-entropy: full sync of missing index %r "
+                            "from %s:%d failed", index_id, host, port)
+                    continue
+                if digests_match(engine.replica_digest(), theirs):
+                    self._bump("digests_matched")
+                    continue
+                self._bump("digests_mismatched")
+                try:
+                    out = self._heal(index_id, engine, host, port)
+                    out.update(index_id=index_id, peer=(host, port))
+                    summary["healed"].append(out)
+                except Exception:
+                    logger.exception(
+                        "anti-entropy: heal of %r from %s:%d failed",
+                        index_id, host, port)
+        self._bump("sweeps")
+        return summary
+
+    def _heal(self, index_id: str, engine, host: str, port: int) -> dict:
+        """Pull this rank's missing state for one index from one peer.
+
+        Order is load-bearing: the peer's deletion ledger applies FIRST
+        (delete-wins, durable before any pull), then the id-set delta
+        decides between a row pull (export_rows) and the full-snapshot
+        path. Full sync REPLACES the local engine, so it is only safe
+        when nothing local-only exists — no local-only live row, no local
+        delete the peer has not recorded; otherwise even a large
+        divergence heals by (chunked) delta, and the peer's own sweep
+        pulls the other direction."""
+        peer = rpc.Client(-1, host, port, connect_timeout=5.0, mux=False)
+        try:
+            sets = peer.generic_fun("get_id_sets", (index_id,),
+                                    timeout=_HEAL_CALL_TIMEOUT_S)
+            mine = engine.id_sets()
+            my_live = {id_match_key(k) for k in mine["live"]}
+            my_dead = {id_match_key(k) for k in mine["dead"]}
+            peer_live_raw = list(sets.get("live") or ())
+            peer_dead = [id_match_key(k) for k in sets.get("dead") or ()]
+            removed = engine.reconcile_deletes(peer_dead) if peer_dead else 0
+            my_dead |= set(peer_dead)
+            missing, seen = [], set()
+            peer_live_keys = set()
+            for raw in peer_live_raw:
+                k = id_match_key(raw)
+                peer_live_keys.add(k)
+                if k in my_live or k in my_dead or k in seen:
+                    continue
+                seen.add(k)
+                missing.append(raw)
+            pulled, full = 0, False
+            local_only = my_live - peer_live_keys - set(peer_dead)
+            extra_dead = my_dead - set(peer_dead)
+            if missing:
+                if (len(missing) > self.cfg.delta_max_rows
+                        and not local_only and not extra_dead):
+                    self.server.sync_shard_from(index_id, host, port)
+                    self._bump("full_syncs")
+                    full = True
+                else:
+                    for i in range(0, len(missing), _DELTA_CHUNK):
+                        emb, meta = peer.generic_fun(
+                            "export_rows",
+                            (index_id, missing[i:i + _DELTA_CHUNK]),
+                            timeout=_HEAL_CALL_TIMEOUT_S)
+                        if len(meta):
+                            engine.add_batch(emb, meta)
+                            pulled += len(meta)
+                    if pulled:
+                        self._bump("rows_repaired", pulled)
+            if removed or pulled or full:
+                logger.info(
+                    "anti-entropy: healed %r from %s:%d (%d deletes "
+                    "applied, %d rows pulled%s)", index_id, host, port,
+                    removed, pulled, ", full sync" if full else "")
+            elif not missing and not local_only and not extra_dead:
+                # digests mismatched but the id-set delta is EMPTY in BOTH
+                # directions (nothing to pull here, nothing peer-missing
+                # for the peer's own sweep to pull): the divergence is
+                # invisible to id sets — typically an id duplicated on one
+                # side by an at-least-once retry whose original send
+                # actually landed. The sweep cannot heal multiplicity (and
+                # must not guess which side is right), so surface it
+                # instead of counting mismatches silently forever: a
+                # counter plus a rate-limited warning naming the operator
+                # remedies. One-directional divergence (local_only /
+                # extra_dead non-empty — the PEER is behind) stays quiet:
+                # pull-only sweeps heal that from the peer's side.
+                self._bump("empty_deltas")
+                now = time.monotonic()
+                with self._lock:
+                    warn = now - self._last_empty_warn >= 60.0
+                    if warn:
+                        self._last_empty_warn = now
+                if warn:
+                    logger.warning(
+                        "anti-entropy: digest mismatch on %r vs %s:%d but "
+                        "the id-set delta is empty — divergence is "
+                        "invisible to id sets (likely a duplicated id from "
+                        "an at-least-once ingest retry); re-ingest the id "
+                        "or resync the smaller replica (sync_shard_from) "
+                        "to converge", index_id, host, port)
+        finally:
+            peer.close()
+        return {"removed": removed, "pulled": pulled, "full_sync": full}
+
+    # ------------------------------------------------------ compaction lease
+
+    def may_compact(self) -> bool:
+        """True while THIS rank holds its group's compaction token:
+        lowest rank among the group members heard from (either direction)
+        within ``lease_ttl_s``, self always included. Unreplicated ranks
+        (no group) always hold their own token. When the leader dies its
+        evidence ages out of the lease window and the next-lowest live
+        rank takes over; the handover window is bounded by the TTL (the
+        lease bounds overlap, it is not a distributed mutex — two
+        replicas can pass within one TTL of a leader flap, which is the
+        same exposure as today's uncoordinated watchers, just rare)."""
+        group = self.server.shard_group
+        if group is None:
+            return True
+        alive = self.health.alive_ranks(group, self.cfg.lease_ttl_s)
+        alive.add(self.server.rank)
+        return self.server.rank == min(alive)
+
+    # -------------------------------------------------------- observability
+
+    def stats(self) -> dict:
+        """The ``antientropy`` perf-stats key."""
+        with self._lock:
+            out = dict(self._counters)
+        out["enabled"] = True
+        out["suspect_peers"] = self.health.suspects()
+        out["compaction_held"] = self.may_compact()
+        return out
+
+    def health_snapshot(self) -> dict:
+        """The ``get_health`` op payload."""
+        with self._lock:
+            counters = dict(self._counters)
+        return {
+            "enabled": True,
+            "rank": self.server.rank,
+            "shard_group": self.server.shard_group,
+            "peers": self.health.snapshot(),
+            "suspects": self.health.suspects(),
+            "compaction": {
+                "held": self.may_compact(),
+                "group": self.server.shard_group,
+                "lease_ttl_s": self.cfg.lease_ttl_s,
+            },
+            "counters": counters,
+        }

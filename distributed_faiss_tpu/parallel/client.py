@@ -39,6 +39,7 @@ import itertools
 import logging
 import os
 import random
+import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -161,6 +162,12 @@ class QuorumError(RuntimeError):
 class IndexClient:
     """Handle to a cluster of index servers (one shard each)."""
 
+    # class-level fallbacks: partially-constructed clients (test fixtures
+    # build via object.__new__) degrade to "no suspects, no driver"
+    _suspects: frozenset = frozenset()
+    _repair_thread: Optional[threading.Thread] = None
+    _repair_stop = threading.Event()
+
     def __init__(self, server_list_path: str, cfg_path: Optional[str] = None,
                  retry_policy: Optional[rpc.RetryPolicy] = None,
                  replication_cfg: Optional[ReplicationCfg] = None):
@@ -210,9 +217,23 @@ class IndexClient:
         # group -> pinned replica position for the read path (updated by
         # failover); guarded by _stats_lock like the other fan-out state
         self._preferred = {}
+        # stub positions the servers' failure detectors mark suspect
+        # (refresh_health): pre-skipped — tried LAST, never removed — in
+        # the read-failover walk. Guarded by _stats_lock.
+        self._suspects = set()
         self.membership = self._build_membership()
         self._register_groups()
         self.cfg = IndexCfg.from_json(cfg_path) if cfg_path is not None else None
+        # opt-in periodic repair driver (DFT_REPAIR_INTERVAL > 0): a
+        # named, tracked thread draining the repair queue and refreshing
+        # the suspect set, so long-lived ingest clients heal without
+        # hand-rolled loops. Joined in close().
+        self._repair_stop = threading.Event()
+        self._repair_thread: Optional[threading.Thread] = None
+        if self.rcfg.repair_interval_s > 0:
+            self._repair_thread = threading.Thread(
+                target=self._repair_loop, name="repair-driver", daemon=True)
+            self._repair_thread.start()
 
     # ------------------------------------------------------------ discovery
 
@@ -240,25 +261,17 @@ class IndexClient:
         hang, with a warning."""
         time_waited = 0.0
         while True:
-            num_servers = None
-            res = []
-            seen = set()
+            msg = None
             try:
+                # the shared parser (replication.parse_discovery_lines —
+                # also the anti-entropy sweeper's peer source) owns the
+                # line format and the restart-dedupe rule; a garbled line
+                # (half-written append) is skipped and simply keeps the
+                # backoff loop waiting for the advertised count
                 with open(server_list_path) as f:
-                    for idx, line in enumerate(f):
-                        line = line.strip()
-                        if not line:
-                            continue
-                        if idx == 0:
-                            num_servers = int(line)
-                        else:
-                            host, port = line.split(",")[:2]
-                            entry = (host.strip(), int(port))
-                            if entry in seen:
-                                continue  # re-registered (restarted) rank
-                            seen.add(entry)
-                            res.append(entry)
+                    num_servers, res = replication.parse_discovery_lines(f)
             except FileNotFoundError:
+                num_servers, res = None, []
                 msg = f"server list {server_list_path} not created yet."
             else:
                 if num_servers is not None and len(res) >= num_servers:
@@ -633,6 +646,56 @@ class IndexClient:
                 repaired += 1
         return {"repaired": repaired, "still_pending": still_pending}
 
+    def _repair_loop(self) -> None:
+        """Body of the opt-in periodic repair driver (DFT_REPAIR_INTERVAL):
+        drain the repair queue, then refresh the suspect set from the
+        servers' health tables. The stop event doubles as the sleep, so
+        close() wakes it immediately."""
+        while not self._repair_stop.wait(self.rcfg.repair_interval_s):
+            try:
+                out = self.repair_under_replicated()
+                if out["repaired"] or out["still_pending"]:
+                    logger.info("repair driver: %s", out)
+            except Exception:
+                logger.exception("periodic repair pass failed")
+            try:
+                self.refresh_health()
+            except Exception:
+                logger.exception("periodic health refresh failed")
+
+    def refresh_health(self) -> set:
+        """Pull each group's server-side failure-detector view (the
+        ``get_health`` op, parallel/antientropy.py) and update the suspect
+        set the read-failover walk pre-skips. One reachable replica per
+        group is asked (its sweeper probes the whole group); a suspect
+        mark only REORDERS the walk — suspect replicas are tried last,
+        never removed, and keep serving direct reads. Returns the new
+        suspect-position set."""
+        addr_to_pos = {(s.host, s.port): pos
+                       for pos, s in enumerate(self.sub_indexes)}
+        suspects = set()
+        for _group, reps in sorted(self.membership.snapshot().items()):
+            for pos in reps:
+                try:
+                    health = self.sub_indexes[pos].generic_fun(
+                        "get_health", (), {}, timeout=5.0)
+                except Exception:
+                    continue  # dead/legacy rank: ask the next replica
+                if not health.get("enabled"):
+                    # sweeper inert on this replica (no discovery file /
+                    # DFT_ANTIENTROPY=0): its stub carries no suspect
+                    # info — ask the next replica instead of silently
+                    # settling for an empty view of the group
+                    continue
+                for s in health.get("suspects") or ():
+                    spos = addr_to_pos.get((s.get("host"), s.get("port")))
+                    if spos is not None:
+                        suspects.add(spos)
+                break
+        with self._stats_lock:
+            self._suspects = set(suspects)
+        return suspects
+
     # ------------------------------------------------------------- mutation
 
     def remove_ids(self, index_id: str, ids) -> int:
@@ -820,7 +883,12 @@ class IndexClient:
         # replica from the last successful call
         with self._stats_lock:
             preferred = dict(self._preferred)
-        plan = replication.plan_read_fanout(self.membership, preferred)
+            suspects = frozenset(self._suspects)
+        # suspect replicas (server-side failure detection, refresh_health)
+        # are pre-skipped: rotated to the tail of their group's failover
+        # walk, still tried when every healthier peer fails
+        plan = replication.plan_read_fanout(self.membership, preferred,
+                                            suspects)
         if not plan:
             raise RuntimeError("no replica groups registered")
 
@@ -1141,10 +1209,16 @@ class IndexClient:
 
     def get_replication_stats(self) -> dict:
         """Client-side replication counters: monotonic totals, the recent
-        reroute ring size, membership, and repair-queue state."""
+        reroute ring size, membership, repair-queue state, and the
+        suspect set. ``degraded`` is True once the bounded repair queue
+        has DROPPED a record — client-driven repair can no longer heal
+        everything it recorded; only the server-side anti-entropy sweep
+        covers the dropped batches."""
         with self._stats_lock:
             counters = dict(self.counters)
             recent = len(self.reroutes)
+            suspects = sorted(self._suspects)
+        repair = self.repair_queue.stats()
         return {
             "counters": counters,
             "recent_reroutes": recent,
@@ -1152,7 +1226,9 @@ class IndexClient:
             "replication": self.rcfg.replication,
             "groups": {g: list(ps)
                        for g, ps in self.membership.snapshot().items()},
-            "repair": self.repair_queue.stats(),
+            "repair": repair,
+            "degraded": repair["dropped"] > 0,
+            "suspects": suspects,
         }
 
     def ping(self, timeout: float = 10.0) -> list:
@@ -1180,6 +1256,12 @@ class IndexClient:
         return self.num_indexes
 
     def close(self):
+        # stop the periodic repair driver BEFORE tearing down the stubs
+        # it re-sends through (the stop event doubles as its sleep)
+        self._repair_stop.set()
+        t = self._repair_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10.0)
         for conn in self.sub_indexes:
             conn.close()
         self.pool.shutdown(wait=False)

@@ -88,7 +88,11 @@ def run_server(rank: int, port: int, discovery_path: str, storage_dir: str,
     setup_server_logging()
     host = host or socketmod.gethostname()
     append_discovery_entry(discovery_path, host, port)
-    server = IndexServer(rank, storage_dir)
+    # the discovery path doubles as the anti-entropy sweeper's peer
+    # source (parallel/antientropy.py) — launcher-spawned ranks heal
+    # their replica groups server-side by default (DFT_ANTIENTROPY=0
+    # turns it off)
+    server = IndexServer(rank, storage_dir, discovery_path=discovery_path)
     server.start_blocking(port, load_index=load_index)
 
 

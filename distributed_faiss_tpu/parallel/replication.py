@@ -33,6 +33,7 @@ one-owner-per-shard behavior exactly: one group per rank, quorum 1.
 """
 
 import logging
+import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
@@ -54,6 +55,42 @@ logger = logging.getLogger()
 # raise sites' shared format (utils/state.py) so a reword there cannot
 # silently disable failover.
 _DRAIN_REJECTION = NOT_TRAINED_REJECTION_FMT.format(state=IndexState.ADD)
+
+
+def parse_discovery_lines(lines) -> Tuple[Optional[int], List[Tuple[str, int]]]:
+    """The ONE parser for ``count\\nhost,port\\n...`` discovery files,
+    shared by every reader (``IndexClient.read_server_list``, the
+    anti-entropy sweeper's ``read_peers``) so the line format and the
+    restart-dedupe rule can never drift apart between them.
+
+    Returns ``(advertised_count, entries)``: the count is ``None`` when
+    line 0 is missing or garbled; body entries dedupe on first occurrence
+    (a restarted rank re-appends its line — stub order stays registration
+    order) and garbled lines are SKIPPED, not raised — a half-written
+    append must never crash a reader."""
+    count: Optional[int] = None
+    entries: List[Tuple[str, int]] = []
+    seen = set()
+    for idx, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        if idx == 0:
+            try:
+                count = int(line)
+            except ValueError:
+                count = None
+            continue
+        try:
+            host, port = line.split(",")[:2]
+            entry = (host.strip(), int(port))
+        except ValueError:
+            continue
+        if entry in seen:
+            continue  # re-registered (restarted) rank
+        seen.add(entry)
+        entries.append(entry)
+    return count, entries
 
 
 def drain_failover_eligible(exc: BaseException) -> bool:
@@ -181,6 +218,7 @@ class MembershipTable:
 def plan_read_fanout(
     membership: MembershipTable,
     preferred: Dict[int, int],
+    suspects=(),
 ) -> List[Tuple[int, int, List[int]]]:
     """One (group, chosen position, failover ordering) triple per group.
 
@@ -192,14 +230,25 @@ def plan_read_fanout(
     per group reaches the merge (groups partition the positions), which
     is what keeps R identical replicas of a shard from ever
     double-counting their rows in the client-side heap merge.
+
+    ``suspects`` (stub positions the server-side failure detector marks
+    suspect — IndexClient.refresh_health) are PRE-SKIPPED, not removed:
+    the rotation is stably partitioned so suspect replicas land at the
+    tail of their group's walk. A suspect replica is still tried when
+    every healthier peer fails — suspicion reorders, it never blacklists
+    (a suspect-marked rank keeps serving direct reads).
     """
     plan: List[Tuple[int, int, List[int]]] = []
+    suspects = frozenset(suspects)
     for group, reps in sorted(membership.snapshot().items()):
         if not reps:
             continue
         pin = preferred.get(group)
         start = reps.index(pin) if pin in reps else 0
         ordering = reps[start:] + reps[:start]
+        if suspects:
+            ordering = ([p for p in ordering if p not in suspects]
+                        + [p for p in ordering if p in suspects])
         plan.append((group, ordering[0], ordering))
     return plan
 
@@ -217,17 +266,40 @@ class RepairQueue:
     monotonic: ``recorded``, ``repaired``, ``dropped``.
     """
 
+    # rate limit on the drop WARNING: the first drop always logs (silent
+    # durability erosion was the bug), repeats at most this often
+    DROP_WARN_INTERVAL_S = 60.0
+
     def __init__(self, maxlen: int = 256):
         self._lock = lockdep.lock("RepairQueue._lock")
         self._items = deque(maxlen=max(1, int(maxlen)))
         self._counters = {"recorded": 0, "repaired": 0, "dropped": 0}
+        self._last_drop_warn = 0.0
 
     def record(self, entry: dict) -> None:
+        warn = None
         with self._lock:
             if len(self._items) == self._items.maxlen:
                 self._counters["dropped"] += 1
+                now = time.monotonic()
+                if (self._counters["dropped"] == 1
+                        or now - self._last_drop_warn
+                        >= self.DROP_WARN_INTERVAL_S):
+                    self._last_drop_warn = now
+                    warn = (self._counters["dropped"], self._items.maxlen)
             self._items.append(entry)
             self._counters["recorded"] += 1
+        if warn is not None:
+            # outside the lock; rate-limited. Client-driven repair can no
+            # longer heal what was dropped — only the server-side
+            # anti-entropy sweep (parallel/antientropy.py) covers it now,
+            # and get_replication_stats() reports degraded=True.
+            logger.warning(
+                "repair queue full (maxlen=%d): dropped oldest "
+                "under-replicated record (%d dropped so far) — repair "
+                "completeness is degraded; raise DFT_REPAIR_QUEUE, run "
+                "repair_under_replicated() more often, or rely on the "
+                "server-side anti-entropy sweep", warn[1], warn[0])
 
     def drain(self) -> List[dict]:
         """Pop every pending record (the repair pass owns them; records

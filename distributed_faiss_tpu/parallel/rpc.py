@@ -146,6 +146,19 @@ KIND_BUSY_MUX = 7
 # window, and the demux reader therefore never sees them.
 KIND_SHARD_FETCH = 8
 KIND_SHARD_DATA = 9
+# anti-entropy digest exchange (parallel/antientropy.py): a rank's
+# sweeper dials a group peer, sends DIGEST with
+# ``{"rank", "group", "want"}`` and receives DIGEST_RESP with the peer's
+# ``{"rank", "shard_group", "digests": {index_id: digest},
+# "compaction": {...}}``. Deliberately LIGHTWEIGHT — pure-scalar dicts,
+# no tensors — because the round-trip doubles as the failure detector's
+# heartbeat and the ChaosProxy drop-kind fault must be able to classify
+# it from the frame header alone. Served on the worker pool
+# (_serve_digest) like shard fetches; like them it rides short-lived
+# DEDICATED connections (rpc.digest_exchange), so the demux reader never
+# sees these kinds.
+KIND_DIGEST = 10
+KIND_DIGEST_RESP = 11
 
 # untagged kind -> its tagged variant (and back), for servers writing
 # req_id-tagged responses and the client-side demux unwrapping them
@@ -804,6 +817,8 @@ class Client:
             return payload
         if kind == KIND_SHARD_DATA:
             return payload
+        if kind == KIND_DIGEST_RESP:
+            return payload
         if kind == KIND_ERROR:
             raise ServerException(payload)
         if kind == KIND_BUSY:
@@ -876,3 +891,39 @@ class Client:
         # teardown no-ops against the bumped epoch and exits
         if reader is not None and reader is not threading.current_thread():
             reader.join(timeout=5.0)
+
+
+def digest_exchange(host: str, port: int, payload: dict,
+                    timeout: float = 5.0, v6: bool = False) -> dict:
+    """One anti-entropy digest round trip on a short-lived DEDICATED
+    connection (the fetch_shard pattern: never this process's serving
+    stubs, so the demux reader never sees the digest kinds). Sends
+    KIND_DIGEST, returns the KIND_DIGEST_RESP payload; server-side
+    failures come back as KIND_ERROR and raise ServerException. The
+    socket deadline bounds the whole exchange — digest round-trips double
+    as the failure detector's heartbeats, so a blackholed peer must fail
+    fast (socket.timeout is an OSError, i.e. TRANSPORT_ERRORS) instead of
+    hanging the sweeper."""
+    fam = socket.AF_INET6 if v6 else socket.AF_INET
+    sock = socket.socket(fam, socket.SOCK_STREAM)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(timeout)
+    try:
+        sock.connect((host, port))
+        send_frame(sock, KIND_DIGEST, dict(payload))
+        kind, resp = recv_frame(sock)
+        try:
+            send_frame(sock, KIND_CLOSE, None)
+        except OSError:
+            pass  # courtesy frame only; the digest already landed
+    finally:
+        sock.close()
+    if kind == KIND_DIGEST_RESP:
+        return resp
+    if kind == KIND_ERROR:
+        raise ServerException(resp)
+    # a garbled kind byte is a transport fault, not a programming error:
+    # FrameError keeps it inside TRANSPORT_ERRORS so the sweeper's
+    # per-peer handler records the failure (note_fail) instead of
+    # aborting the whole round
+    raise FrameError(f"unexpected frame kind {kind}")

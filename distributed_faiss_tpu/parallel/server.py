@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from distributed_faiss_tpu.engine import Index
-from distributed_faiss_tpu.parallel import rpc
+from distributed_faiss_tpu.parallel import antientropy, rpc
 from distributed_faiss_tpu.serving.scheduler import (
     DeadlineExpired,
     SchedulerBusy,
@@ -41,7 +41,11 @@ from distributed_faiss_tpu.serving.scheduler import (
     SearchScheduler,
 )
 from distributed_faiss_tpu.utils import lockdep
-from distributed_faiss_tpu.utils.config import IndexCfg, SchedulerCfg
+from distributed_faiss_tpu.utils.config import (
+    AntiEntropyCfg,
+    IndexCfg,
+    SchedulerCfg,
+)
 from distributed_faiss_tpu.utils.state import IndexState
 from distributed_faiss_tpu.utils.tracing import LatencyStats
 
@@ -72,9 +76,21 @@ def setup_server_logging(level=logging.INFO) -> None:
 
 class IndexServer:
     def __init__(self, rank: int, index_storage_dir: str,
-                 scheduler_cfg: Optional[SchedulerCfg] = None):
+                 scheduler_cfg: Optional[SchedulerCfg] = None,
+                 discovery_path: Optional[str] = None,
+                 antientropy_cfg: Optional[AntiEntropyCfg] = None):
         self.indexes: Dict[str, Index] = {}
         self.indexes_lock = lockdep.lock("IndexServer.indexes_lock")
+        # index-level drop tombstones: ids this rank has dropped, so the
+        # anti-entropy sweeper never full-syncs a dropped index back from
+        # a peer that missed the drop broadcast (per-id deletes ride the
+        # TombstoneSet ledger; drops need their own marker). Cleared by an
+        # explicit re-create/load/resync. In-memory only: a restart that
+        # reloads the index from disk resurrects it regardless of the
+        # sweeper, which is a persistence question, not an anti-entropy
+        # one (drop_index leaves storage in place by design).
+        self._dropped: set = set()
+        self._v6 = False
         self.rank = rank
         self.index_storage_dir = index_storage_dir
         self.socket: Optional[socket.socket] = None
@@ -117,19 +133,42 @@ class IndexServer:
         self._mux_lock = lockdep.lock("IndexServer._mux_lock")
         self._mux_inflight = 0
         self._mux_counters = {"mux_calls": 0, "legacy_calls": 0}
+        # server-side anti-entropy (parallel/antientropy.py): a named,
+        # tracked sweeper thread exchanging replica digests with this
+        # rank's group peers, healing divergence by pulling, doubling as
+        # the failure detector behind get_health, and holding the
+        # per-group compaction lease. It needs the discovery file to
+        # resolve peers, so ranks constructed without one (most unit
+        # tests, standalone engines) stay inert; the thread starts once
+        # the serving socket is bound (either loop) so the sweeper can
+        # recognize its own discovery entry by port.
+        self.discovery_path = discovery_path
+        self._antientropy_cfg = (antientropy_cfg if antientropy_cfg is not None
+                                 else AntiEntropyCfg.from_env())
+        self._antientropy: Optional[antientropy.AntiEntropySweeper] = None
 
     # ------------------------------------------------------------ RPC surface
 
     def create_index(self, index_id: str, cfg: IndexCfg) -> bool:
+        # the common duplicate case (every client broadcasts create on
+        # setup) must not construct an Index at all — a construction
+        # spawns save/compaction watcher threads just to retire them
+        with self.indexes_lock:
+            if index_id in self.indexes:
+                return False
         index_storage_dir = self._get_storage_dir(index_id, cfg)
         cfg.index_storage_dir = index_storage_dir
         pathlib.Path(index_storage_dir).mkdir(parents=True, exist_ok=True)
+        index = Index(cfg)
+        self._wire_engine(index)
         with self.indexes_lock:
             if index_id not in self.indexes:
-                self.indexes[index_id] = Index(cfg)
+                self.indexes[index_id] = index
+                self._dropped.discard(index_id)
                 logger.info("created index %s (storage %s)", index_id, index_storage_dir)
                 return True
-            return False
+        index.retire()  # lost the race: never let its watcher autosave
+        return False
 
     def add_index_data(
         self,
@@ -234,13 +273,20 @@ class IndexServer:
         index = Index.from_storage_dir(index_dir, cfg, ignore_buffer=False)
         if index is None:
             return False
+        self._wire_engine(index)
         with self.indexes_lock:
             self.indexes[index_id] = index
+            self._dropped.discard(index_id)
         return True
 
     def drop_index(self, index_id: str) -> None:
         with self.indexes_lock:
             old = self.indexes.pop(index_id, None)
+            # marked even when this rank never served the id: the drop
+            # broadcast may reach a rank before the index ever synced to
+            # it, and the marker is what stops the sweeper from pulling
+            # the dropped index back from a peer that missed the drop
+            self._dropped.add(index_id)
         if old is not None:
             # stop the dropped engine's save watcher: a late autosave
             # would resurrect the index on disk after the drop
@@ -298,9 +344,11 @@ class IndexServer:
             src.close()
         index = Index.import_snapshot(
             snapshot, self._get_storage_dir(index_id, None))
+        self._wire_engine(index)
         with self.indexes_lock:
             old = self.indexes.get(index_id)
             self.indexes[index_id] = index
+            self._dropped.discard(index_id)
         if old is not None:
             # the storage dir now belongs to the transferred shard: the
             # superseded engine must never autosave its stale state over
@@ -315,6 +363,99 @@ class IndexServer:
         return {"rank": self.rank, "index_id": index_id, "ntotal": ntotal,
                 "buffered": buffered, "generation": index._generation,
                 "shard_group": self.shard_group}
+
+    # ---------------------------------------------------------- anti-entropy
+
+    def _wire_engine(self, index: Index) -> None:
+        """Install the compaction-lease gate on an engine entering the
+        registry (the sweeper re-asserts every sweep, so engines that
+        predate the sweeper converge too)."""
+        if self._antientropy is not None:
+            index.compaction_gate = self._antientropy.may_compact
+
+    def _start_antientropy(self) -> None:
+        """Start the sweeper once the serving socket is bound. Inert
+        without a discovery file (nothing to resolve peers from) or with
+        DFT_ANTIENTROPY=0."""
+        if (self._antientropy is not None or self.discovery_path is None
+                or not self._antientropy_cfg.enabled):
+            return
+        self._antientropy = antientropy.AntiEntropySweeper(
+            self, self.discovery_path, self._antientropy_cfg)
+        with self.indexes_lock:
+            engines = list(self.indexes.values())
+        for index in engines:
+            self._wire_engine(index)
+        self._antientropy.start()
+        logger.info("anti-entropy sweeper started (rank %d, group %s, "
+                    "interval %.1fs)", self.rank, self.shard_group,
+                    self._antientropy_cfg.interval_s)
+
+    def get_health(self) -> dict:
+        """Failure-detector surface: this rank's view of its peers —
+        suspect marks, per-peer failure counts, and the compaction-lease
+        holder. Clients consult it to pre-skip suspect replicas in the
+        read-failover walk (IndexClient.refresh_health); a suspect mark
+        never REMOVES a replica from rotation — suspect peers are tried
+        last, and still serve direct reads."""
+        if self._antientropy is None:
+            return {"enabled": False, "rank": self.rank,
+                    "shard_group": self.shard_group, "peers": {},
+                    "suspects": [], "compaction": {"held": True}}
+        return self._antientropy.health_snapshot()
+
+    def get_id_sets(self, index_id: str) -> dict:
+        """Anti-entropy delta protocol: this shard's normalized live-id
+        set and deletion ledger (engine.id_sets)."""
+        return self._get_index(index_id).id_sets()
+
+    def export_rows(self, index_id: str, ids) -> Tuple:
+        """Anti-entropy delta protocol: (embeddings, metadata) for the
+        requested live ids (engine.export_rows) — the pull side of a
+        peer's delta repair."""
+        return self._get_index(index_id).export_rows(ids)
+
+    def _serve_digest(self, conn: socket.socket, payload,
+                      wlock: Optional[threading.Lock] = None) -> None:
+        """Answer one KIND_DIGEST with this rank's per-index replica
+        digests and lease state as a KIND_DIGEST_RESP frame (failures
+        degrade to a structured KIND_ERROR). Runs on the worker pool —
+        digest computation may hash O(rows) on a cache miss and must not
+        occupy the selector loop's shared reader. The inbound contact is
+        itself liveness evidence for the failure detector."""
+        t0 = time.perf_counter()
+        try:
+            req = payload if isinstance(payload, dict) else {}
+            if self._antientropy is not None:
+                self._antientropy.health.note_inbound(
+                    req.get("rank"), req.get("group"))
+            want = req.get("want")
+            with self.indexes_lock:
+                snapshot = list(self.indexes.items())
+            digests = {iid: idx.replica_digest() for iid, idx in snapshot
+                       if want is None or iid in want}
+            held = (self._antientropy.may_compact()
+                    if self._antientropy is not None else True)
+            resp = {
+                "rank": self.rank,
+                "shard_group": self.shard_group,
+                "digests": digests,
+                "compaction": {"held": held},
+            }
+            parts = rpc.pack_frame(rpc.KIND_DIGEST_RESP, resp)
+            self.perf.record("digest_exchange", time.perf_counter() - t0)
+        except Exception:
+            tb = traceback.format_exc()
+            logger.error("digest exchange failed: %s", tb)
+            parts = rpc.pack_frame(rpc.KIND_ERROR, tb)
+        try:
+            if wlock is not None:
+                with wlock:
+                    rpc._send_parts(conn, parts)
+            else:
+                rpc._send_parts(conn, parts)
+        except OSError as e:
+            logger.info("digest response write failed (peer gone?): %s", e)
 
     def index_loaded(self, index_id: str) -> bool:
         with self.indexes_lock:
@@ -354,6 +495,12 @@ class IndexServer:
         # ``replication.client`` (parallel/replication.py)
         out["replication"] = {"rank": self.rank,
                               "shard_group": self.shard_group}
+        # anti-entropy observability: sweep/digest/repair counters,
+        # suspect peers, and whether this rank holds its group's
+        # compaction lease — docs/OPERATIONS.md#anti-entropy--health
+        out["antientropy"] = (self._antientropy.stats()
+                              if self._antientropy is not None
+                              else {"enabled": False})
         with self.indexes_lock:
             snapshot = list(self.indexes.items())
         out["engine"] = {iid: idx.perf_stats() for iid, idx in snapshot}
@@ -395,6 +542,11 @@ class IndexServer:
     def stop(self) -> None:
         logger.info("stopping server rank=%d", self.rank)
         self._stopping.set()
+        # stop the anti-entropy sweeper first: a sweep mid-heal would
+        # race the shutdown saves for the engine locks, and its peer
+        # dials are bounded so the join is too
+        if self._antientropy is not None:
+            self._antientropy.stop()
         if self.socket is not None:
             try:
                 self.socket.shutdown(socket.SHUT_RDWR)
@@ -443,6 +595,7 @@ class IndexServer:
         if load_index:
             self.load_index()
         s = self._bind(port, v6)
+        self._start_antientropy()
         logger.info("server rank=%d listening on :%d", self.rank, port)
         while not self._stopping.is_set():
             try:
@@ -493,6 +646,13 @@ class IndexServer:
             # write lock
             self._rpc_workers.submit(self._serve_shard_fetch, conn,
                                      payload, wlock)
+            return
+        if kind == rpc.KIND_DIGEST:
+            # anti-entropy digest exchange: same worker-pool contract as
+            # shard fetches — a cache-miss digest hashes O(rows) and the
+            # selector loop's shared reader must never pay for it
+            self._rpc_workers.submit(self._serve_digest, conn, payload,
+                                     wlock)
             return
         if kind != rpc.KIND_CALL:
             raise RuntimeError(f"unexpected frame kind {kind}")
@@ -754,6 +914,7 @@ class IndexServer:
         (for a one-in-flight peer, waiting for followers that structurally
         cannot arrive would be pure added latency)."""
         s = self._bind(port, v6)
+        self._start_antientropy()
         s.setblocking(True)
         sel = selectors.DefaultSelector()
         sel.register(s, selectors.EVENT_READ, data=None)
@@ -823,9 +984,13 @@ def main(argv=None):
     parser.add_argument("--storage-dir", required=True)
     parser.add_argument("--ipv6", action="store_true")
     parser.add_argument("--load-index", action="store_true")
+    parser.add_argument("--discovery", default=None,
+                        help="discovery file path; enables the anti-entropy "
+                             "sweeper (peer resolution)")
     args = parser.parse_args(argv)
     setup_server_logging()
-    server = IndexServer(args.rank, args.storage_dir)
+    server = IndexServer(args.rank, args.storage_dir,
+                         discovery_path=args.discovery)
     server.start_blocking(args.port, v6=args.ipv6, load_index=args.load_index)
 
 

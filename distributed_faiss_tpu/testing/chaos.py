@@ -26,7 +26,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from distributed_faiss_tpu.parallel import launcher
+from distributed_faiss_tpu.parallel import launcher, rpc
 from distributed_faiss_tpu.utils import lockdep
 
 logger = logging.getLogger()
@@ -49,6 +49,13 @@ class Fault:
         corruption that keeps the stream length intact).
       - ``cut``: forward exactly ``after_bytes`` bytes of ``direction``,
         then close both sides mid-frame.
+      - ``drop_kind``: parse the ``direction`` stream at FRAME granularity
+        (header fields only — lengths and the kind byte; payload bytes are
+        never decoded or unpickled) and silently swallow every frame whose
+        wire kind is in ``drop_kinds``, forwarding all other frames
+        untouched. This is the surgical fault the anti-entropy failure
+        detector is tested with: blackhole only the KIND_DIGEST exchange
+        while query traffic on the same link flows normally.
     """
 
     LATENCY = "latency"
@@ -56,24 +63,28 @@ class Fault:
     BLACKHOLE = "blackhole"
     GARBLE = "garble"
     CUT = "cut"
-    KINDS = frozenset({LATENCY, RESET, BLACKHOLE, GARBLE, CUT})
+    DROP_KIND = "drop_kind"
+    KINDS = frozenset({LATENCY, RESET, BLACKHOLE, GARBLE, CUT, DROP_KIND})
 
     def __init__(self, kind: str, delay: float = 0.05, after_bytes: int = 0,
-                 nbytes: int = 8, direction: str = "up"):
+                 nbytes: int = 8, direction: str = "up", drop_kinds=None):
         if kind not in self.KINDS:
             raise ValueError(f"unknown fault kind {kind!r}")
         if direction not in ("up", "down"):
             raise ValueError("direction must be 'up' (client->server) or 'down'")
+        if kind == self.DROP_KIND and not drop_kinds:
+            raise ValueError("drop_kind fault needs a non-empty drop_kinds set")
         self.kind = kind
         self.delay = delay
         self.after_bytes = after_bytes
         self.nbytes = nbytes
         self.direction = direction
+        self.drop_kinds = frozenset(int(k) for k in (drop_kinds or ()))
 
     def __repr__(self):
         return (f"Fault({self.kind!r}, delay={self.delay}, "
                 f"after_bytes={self.after_bytes}, nbytes={self.nbytes}, "
-                f"direction={self.direction!r})")
+                f"direction={self.direction!r}, drop_kinds={set(self.drop_kinds)})")
 
 
 def _rst_close(sock: socket.socket) -> None:
@@ -209,6 +220,9 @@ class ChaosProxy:
 
     def _pump(self, src: socket.socket, dst: socket.socket,
               fault: Optional[Fault]) -> None:
+        if fault is not None and fault.kind == Fault.DROP_KIND:
+            self._pump_frames(src, dst, fault)
+            return
         sent = 0
         try:
             while True:
@@ -244,6 +258,75 @@ class ChaosProxy:
             pass
         # one direction ended: tear down both so the peer sees EOF, not a
         # half-open connection
+        _quiet_close(src)
+        _quiet_close(dst)
+        self._forget(src, dst)
+
+    # frame header shared with parallel/rpc.py (magic, kind u8, skel_len
+    # u32, narr u32) — aliased, not mirrored, so a wire-format change
+    # cannot silently desync the proxy into corrupting streams instead of
+    # dropping frames. The proxy reads LENGTH fields and the kind byte
+    # only; payload bytes are forwarded (or dropped) opaque, never
+    # unpickled. _read_exact stays local: the pump needs owned bytes
+    # (indexing, .decode()), not rpc._recv_exact's memoryview.
+    _FRAME_HDR = rpc._HDR
+    _FRAME_MAGIC = rpc.MAGIC
+
+    @staticmethod
+    def _read_exact(sock: socket.socket, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(min(_CHUNK, n - len(buf)))
+            if not chunk:
+                raise EOFError("stream ended mid-frame")
+            buf += chunk
+        return bytes(buf)
+
+    def _pump_frames(self, src: socket.socket, dst: socket.socket,
+                     fault: Fault) -> None:
+        """Frame-granular pump for drop_kind faults: swallow whole frames
+        of the targeted kinds, forward every other frame byte-exact. A
+        stream that stops framing (bad magic — not this protocol, or
+        already desynced) degrades to raw forwarding of what was read."""
+        import numpy as _np
+
+        try:
+            while True:
+                head = self._read_exact(src, self._FRAME_HDR.size)
+                magic, kind, skel_len, narr = self._FRAME_HDR.unpack(head)
+                if magic != self._FRAME_MAGIC:
+                    # unknown dialect: stop parsing, forward verbatim
+                    dst.sendall(head)
+                    while True:
+                        data = src.recv(_CHUNK)
+                        if not data:
+                            break
+                        dst.sendall(data)
+                    break
+                parts = [head, self._read_exact(src, skel_len)]
+                for _ in range(narr):
+                    dl = self._read_exact(src, 1)
+                    dt = self._read_exact(src, dl[0])
+                    nd = self._read_exact(src, 1)
+                    dims_raw = self._read_exact(src, 8 * nd[0])
+                    dims = struct.unpack(f"<{nd[0]}Q", dims_raw)
+                    itemsize = _np.dtype(dt.decode()).itemsize
+                    nbytes = itemsize
+                    for d in dims:
+                        nbytes *= d
+                    parts += [dl, dt, nd, dims_raw,
+                              self._read_exact(src, int(nbytes))]
+                if kind in fault.drop_kinds:
+                    continue  # swallowed: the peer never sees this frame
+                for p in parts:
+                    dst.sendall(p)
+        except (OSError, EOFError, ValueError, TypeError):
+            # ValueError/TypeError: a desynced stream fed garbage into
+            # np.dtype(dt.decode()) — same terminal condition as a torn
+            # socket, and the cleanup below must still run (a dead pump
+            # thread that skips it leaks both sockets and wedges the
+            # peer mid-frame until its own timeout)
+            pass
         _quiet_close(src)
         _quiet_close(dst)
         self._forget(src, dst)
@@ -322,7 +405,8 @@ class ServerHarness:
         into its replica group (replication membership)."""
         cmd = [sys.executable, "-m", "distributed_faiss_tpu.parallel.server",
                "--rank", str(rank), "--port", str(self.port(rank)),
-               "--storage-dir", self.storage_dir]
+               "--storage-dir", self.storage_dir,
+               "--discovery", self.discovery_path]
         if load_index:
             cmd.append("--load-index")
         proc = subprocess.Popen(

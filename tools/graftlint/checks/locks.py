@@ -94,6 +94,24 @@ PINS = {
     # never stall the serving locks
     ("Index", "_tombstone_version"): "index_lock",
     ("Index", "_tombstone_written"): "_tombstone_io_lock",
+    # anti-entropy subsystem (parallel/antientropy.py + engine/client
+    # wiring): the cached replica digest rides index_lock (read/written
+    # under both engine locks; add_batch's ledger-prune invalidation
+    # holds index_lock alone); the health table's peer/inbound maps are
+    # shared between the sweeper thread and the worker pool's
+    # _serve_digest handlers; the sweeper's counters between the sweep
+    # loop and perf-stats readers; the client's suspect set between
+    # refresh_health (repair driver thread) and every read fan-out; the
+    # repair queue's drop-warning clock rides its own lock like the
+    # counters beside it
+    ("Index", "_digest_cache"): "index_lock",
+    ("IndexServer", "_dropped"): "indexes_lock",
+    ("HealthTable", "_peers"): "_lock",
+    ("HealthTable", "_inbound"): "_lock",
+    ("AntiEntropySweeper", "_counters"): "_lock",
+    ("AntiEntropySweeper", "_last_empty_warn"): "_lock",
+    ("IndexClient", "_suspects"): "_stats_lock",
+    ("RepairQueue", "_last_drop_warn"): "_lock",
 }
 
 _SKIP_METHODS = frozenset({"__init__", "__new__", "__del__"})
