@@ -20,7 +20,6 @@ serializes device-touching operations per index (the reference does the
 same for FAISS, index.py:246-252).
 """
 
-import _thread
 import hashlib
 import logging
 import os
@@ -39,7 +38,7 @@ from distributed_faiss_tpu.models.factory import (
 from distributed_faiss_tpu.mutation import compaction as _compaction
 from distributed_faiss_tpu.mutation import tombstones as _tombstones
 from distributed_faiss_tpu.mutation.tombstones import TombstoneSet
-from distributed_faiss_tpu.utils import lockdep, serialization
+from distributed_faiss_tpu.utils import envutil, lockdep, serialization
 from distributed_faiss_tpu.utils.batching import SearchBatcher
 from distributed_faiss_tpu.utils.config import IndexCfg, MutationCfg
 from distributed_faiss_tpu.utils.serialization import (
@@ -241,6 +240,15 @@ class Index:
         # commit its stale state as a NEWER generation over the
         # replacement's storage dir
         self._retired = threading.Event()
+        # background worker threads, tracked so retire() has a join path
+        # (thread-lifecycle discipline): the two watchers wake on the
+        # retired event and exit immediately; train/add are the transient
+        # state-machine workers (at most one of each — the TRAINING/ADD
+        # state gate), joined best-effort
+        self._save_thread: Optional[threading.Thread] = None
+        self._compaction_thread: Optional[threading.Thread] = None
+        self._train_thread: Optional[threading.Thread] = None
+        self._add_thread: Optional[threading.Thread] = None
 
         self.index_save_time = time.time()
         self.index_saved_size = 0
@@ -367,7 +375,11 @@ class Index:
         elif state == IndexState.NOT_TRAINED and 0 < self.cfg.train_num <= total_data:
             logger.info("buffer reached %d >= train_num, triggering training", total_data)
             if train_async_if_triggered:
-                _thread.start_new_thread(self.train, ())
+                t = threading.Thread(
+                    target=self.train, name=f"train:{self._thread_tag()}",
+                    daemon=True)
+                self._train_thread = t
+                t.start()
             else:
                 self.train()
 
@@ -521,7 +533,6 @@ class Index:
             if version <= self._tombstone_written:
                 return
             os.makedirs(storage_dir, exist_ok=True)
-            # graftlint: ok(blocking-under-lock): dedicated leaf writer lock — ordering for the sidecar file only, never held with the serving locks
             _tombstones.write_sidecar(storage_dir, payload)
             self._tombstone_written = version
 
@@ -746,7 +757,7 @@ class Index:
             state = self.tpu_index.state_dict()
 
         # ---- phase 2: rebuild with serving live ----
-        delay = float(os.environ.get("DFT_COMPACT_TEST_DELAY_S", "0") or 0)
+        delay = envutil.env_float("DFT_COMPACT_TEST_DELAY_S", 0.0)
         if delay:
             # chaos-test hook: widen the mid-pass window so the SIGKILL
             # gate can land deterministically inside an uncommitted rebuild
@@ -828,11 +839,17 @@ class Index:
             "generation %d in %.3fs", n0 - new_n, n0, new_n, gen, dt)
         return True
 
+    def _thread_tag(self) -> str:
+        """Short per-engine tag for worker-thread names (stack dumps and
+        thread-leak reports must attribute to a shard, not 'Thread-N')."""
+        return (os.path.basename(self.cfg.index_storage_dir or "")
+                or f"mem-{id(self):x}")
+
     def _run_compaction_watcher(self) -> None:
-        name = os.path.basename(self.cfg.index_storage_dir or "?")
         t = threading.Thread(
             target=_compaction.run_watcher, args=(self, self.mutation_cfg),
-            name=f"compaction:{name}", daemon=True)
+            name=f"compaction:{self._thread_tag()}", daemon=True)
+        self._compaction_thread = t
         t.start()
 
     def get_idx_data_num(self) -> Tuple[int, int]:
@@ -938,7 +955,11 @@ class Index:
         if add_to_index:
             # async so the serving thread keeps handling requests while the
             # device runs encode+append (reference: index.py:225-238)
-            _thread.start_new_thread(self._add_buffer_to_idx, ())
+            t = threading.Thread(
+                target=self._add_buffer_to_idx,
+                name=f"add:{self._thread_tag()}", daemon=True)
+            self._add_thread = t
+            t.start()
 
     def _add_buffer_to_idx(self) -> None:
         while True:
@@ -1215,8 +1236,16 @@ class Index:
         when a server swaps this engine out of its registry — the
         storage dir now belongs to the replacement, and a late autosave
         from this instance would commit stale state as the newest
-        generation there."""
+        generation there. Joins the tracked worker threads bounded: the
+        watchers wake on the retired event and exit immediately; a
+        still-running train/add worker past the timeout is harmless
+        (``_maybe_save`` no-ops once retired), so the join is
+        best-effort rather than a hostage-taking wait on device work."""
         self._retired.set()
+        for t in (self._save_thread, self._compaction_thread,
+                  self._train_thread, self._add_thread):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=1.0)
 
     def _maybe_save(self, ignore_time: bool = False) -> bool:
         if self._retired.is_set():
@@ -1572,7 +1601,9 @@ class Index:
             while not idx._retired.wait(idx.cfg.save_interval_sec):
                 idx._maybe_save(ignore_time=False)
 
-        t = threading.Thread(target=_watch, args=(self,), daemon=True)
+        t = threading.Thread(target=_watch, args=(self,),
+                             name=f"save:{self._thread_tag()}", daemon=True)
+        self._save_thread = t
         t.start()
 
     # kept for API parity with the reference's static helper

@@ -48,7 +48,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from distributed_faiss_tpu.parallel import replication, rpc
-from distributed_faiss_tpu.utils import lockdep
+from distributed_faiss_tpu.utils import envutil, lockdep
 from distributed_faiss_tpu.utils.config import IndexCfg, ReplicationCfg
 from distributed_faiss_tpu.utils.state import IndexState
 
@@ -69,9 +69,9 @@ def client_pool_size(num_indexes: int) -> int:
     flight (and the RPC mux had nothing to pipeline). ``DFT_CLIENT_POOL``
     overrides; the default budgets 8 concurrent full fan-outs (executor
     threads spawn lazily, so an idle budget costs nothing)."""
-    raw = os.environ.get("DFT_CLIENT_POOL")
+    raw = envutil.env_int("DFT_CLIENT_POOL")
     if raw:
-        return max(int(raw), num_indexes)
+        return max(raw, num_indexes)
     return 8 * max(num_indexes, 1)
 
 
@@ -317,7 +317,7 @@ class IndexClient:
             pos, stub = pair
             try:
                 gid = self._call_with_retry(stub, "get_shard_group")
-            except Exception:
+            except rpc.TRANSPORT_ERRORS + (rpc.ServerException,):
                 gid = None  # legacy server or dead rank: derived striping
             return derived[pos] if gid is None else int(gid)
 
@@ -375,7 +375,7 @@ class IndexClient:
                 out = self._call_with_retry(
                     self.sub_indexes[pos], "sync_shard_from",
                     (index_id, src_stub.host, src_stub.port, group))
-            except Exception as e:
+            except rpc.TRANSPORT_ERRORS + (rpc.ServerException,) as e:
                 last_exc = e
                 logger.warning("resync of rank %s from replica %s failed: "
                                "%s", pos, src, e)
@@ -679,7 +679,7 @@ class IndexClient:
                 try:
                     health = self.sub_indexes[pos].generic_fun(
                         "get_health", (), {}, timeout=5.0)
-                except Exception:
+                except rpc.TRANSPORT_ERRORS + (rpc.ServerException,):
                     continue  # dead/legacy rank: ask the next replica
                 if not health.get("enabled"):
                     # sweeper inert on this replica (no discovery file /

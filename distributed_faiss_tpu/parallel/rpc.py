@@ -43,7 +43,7 @@ import time
 
 import numpy as np
 
-from distributed_faiss_tpu.utils import lockdep
+from distributed_faiss_tpu.utils import envutil, lockdep
 from distributed_faiss_tpu.utils.tracing import LatencyStats
 
 DEFAULT_PORT = 12032  # same default port as the reference (rpc.py:22)
@@ -88,7 +88,11 @@ _SAFE_PACKAGE_GLOBALS = frozenset({
 
 
 def _unsafe_pickle_ok() -> bool:
-    return os.environ.get("DFT_RPC_UNSAFE_PICKLE", "0") == "1"
+    # strictly '1', NOT env_flag truthiness: this knob disables the
+    # restricted unpickler on wire bytes, and a security opt-out must not
+    # widen to accept 'true'/'yes'/'2' spellings that never enabled it
+    # before — the conservative direction for a misspelled value is OFF
+    return envutil.env_str("DFT_RPC_UNSAFE_PICKLE") == "1"
 
 
 class _RestrictedUnpickler(pickle.Unpickler):
@@ -115,7 +119,7 @@ class _RestrictedUnpickler(pickle.Unpickler):
 def restricted_loads(data) -> object:
     """``pickle.loads`` for wire bytes, through the allowlisted Unpickler."""
     if _unsafe_pickle_ok():
-        return pickle.loads(data)  # graftlint: ok(pickle-safety): explicit operator opt-out
+        return pickle.loads(data)
     return _RestrictedUnpickler(io.BytesIO(bytes(data))).load()
 
 MAGIC = b"DFT1"
@@ -175,7 +179,7 @@ _HDR = struct.Struct("<4sBII")
 def mux_enabled_by_env() -> bool:
     """DFT_RPC_MUX master switch (default on): 0 restores the serial
     one-call-per-connection client (the pre-mux A/B arm)."""
-    return os.environ.get("DFT_RPC_MUX", "1") not in ("0", "false", "False", "")
+    return envutil.env_flag("DFT_RPC_MUX", True)
 
 
 # kernel-level bound on a single zero-progress frame write, applied to
@@ -337,6 +341,7 @@ def _extract(obj, arrays):
     if hasattr(obj, "__array__") and not isinstance(obj, (str, bytes)):
         try:
             return _extract(np.asarray(obj), arrays)
+        # graftlint: ok(exception-classification): duck-typing probe — an array-like whose conversion fails (any class) must degrade to pickling the object itself, not kill pack_frame
         except Exception:
             return obj
     return obj
@@ -611,6 +616,7 @@ class Client:
             try:
                 err = type(exc)(*exc.args)
                 err.__cause__ = exc
+            # graftlint: ok(exception-classification): exception-COPY fallback — an exotic ctor signature degrades to sharing the original instance; the class is preserved either way
             except Exception:
                 err = exc
             slot.error = err

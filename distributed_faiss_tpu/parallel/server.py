@@ -18,7 +18,6 @@ Conscious fixes vs the reference:
   parallelism.
 """
 
-import _thread
 import logging
 import os
 import pathlib
@@ -40,7 +39,7 @@ from distributed_faiss_tpu.serving.scheduler import (
     SchedulerStopped,
     SearchScheduler,
 )
-from distributed_faiss_tpu.utils import lockdep
+from distributed_faiss_tpu.utils import envutil, lockdep
 from distributed_faiss_tpu.utils.config import (
     AntiEntropyCfg,
     IndexCfg,
@@ -57,9 +56,9 @@ def rpc_worker_count() -> int:
     ops and writes scheduler completions back to their connections.
     DFT_RPC_WORKERS overrides; the default is small — search (the hot path)
     never occupies a worker for its compute, only for its response write."""
-    raw = os.environ.get("DFT_RPC_WORKERS")
+    raw = envutil.env_int("DFT_RPC_WORKERS")
     if raw:
-        return max(1, int(raw))
+        return max(1, raw)
     return min(8, max(2, os.cpu_count() or 4))
 
 
@@ -109,9 +108,7 @@ class IndexServer:
         # derives a default from discovery order and pushes it via the
         # set_shard_group op; DFT_SHARD_GROUP pins it at launch (a rank
         # rejoining a known group after restart).
-        raw_group = os.environ.get("DFT_SHARD_GROUP")
-        self.shard_group: Optional[int] = (
-            int(raw_group) if raw_group not in (None, "") else None)
+        self.shard_group: Optional[int] = envutil.env_int("DFT_SHARD_GROUP")
         cfg = scheduler_cfg if scheduler_cfg is not None else SchedulerCfg.from_env()
         self.scheduler: Optional[SearchScheduler] = None
         if cfg.enabled:
@@ -607,7 +604,16 @@ class IndexServer:
             # shared worker pool, so a stalled peer must cost one worker
             # at most SEND_TIMEOUT_S before its connection is dropped
             rpc.bound_send_timeout(conn)
-            _thread.start_new_thread(self._serve_connection, (conn, addr))
+            # per-connection reader: named so stack dumps attribute to a
+            # peer, daemon + deliberately unjoined — its lifetime IS the
+            # connection's (it exits when the peer closes or the socket
+            # dies), and joining here would hold stop() hostage to every
+            # still-connected remote peer
+            # graftlint: ok(thread-lifecycle): per-connection reader — lifetime is the connection's; a join path would hostage stop() to remote peers
+            t = threading.Thread(
+                target=self._serve_connection, args=(conn, addr),
+                name=f"conn:r{self.rank}:{addr[0]}:{addr[1]}", daemon=True)
+            t.start()
 
     def _serve_connection(self, conn: socket.socket, addr) -> None:
         # one write lock per connection: mux responses are written by

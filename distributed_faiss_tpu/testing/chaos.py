@@ -129,6 +129,12 @@ class ChaosProxy:
         self._default_fault: Optional[Fault] = None
         self._accepted = 0
         self._conns: List[socket.socket] = []
+        # forwarding threads (accept loop, per-connection handler/pump):
+        # tracked so stop() can join them after tearing their sockets
+        # down — a drill must not bleed pump threads into the next test
+        # (the DFT_THREADCHECK witness polices exactly that)
+        self._threads: List[threading.Thread] = []
+        self._accept_thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
         self._listener: Optional[socket.socket] = None
         self.port: Optional[int] = None
@@ -142,8 +148,10 @@ class ChaosProxy:
         s.listen(16)
         self._listener = s
         self.port = s.getsockname()[1]
-        threading.Thread(target=self._accept_loop, daemon=True,
-                         name=f"chaos-accept:{self.port}").start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"chaos-accept:{self.port}")
+        self._accept_thread.start()
         return self
 
     def stop(self) -> None:
@@ -152,8 +160,16 @@ class ChaosProxy:
             _quiet_close(self._listener)
         with self._lock:
             conns, self._conns = self._conns, []
+            threads, self._threads = self._threads, []
         for c in conns:
             _quiet_close(c)
+        # closed sockets wake every pump/handler out of recv; the joins
+        # are bounded so a wedged kernel socket can't hostage teardown
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        for t in threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
 
     def __enter__(self) -> "ChaosProxy":
         return self.start()
@@ -189,8 +205,12 @@ class ChaosProxy:
             except OSError:
                 break
             fault = self._next_fault()
-            threading.Thread(target=self._handle, args=(client, fault),
-                             daemon=True).start()
+            t = threading.Thread(target=self._handle, args=(client, fault),
+                                 daemon=True,
+                                 name=f"chaos-conn:{self.port}")
+            with self._lock:
+                self._threads.append(t)
+            t.start()
 
     def _handle(self, client: socket.socket, fault: Optional[Fault]) -> None:
         if fault is not None and fault.kind == Fault.RESET and fault.after_bytes == 0:
@@ -214,8 +234,12 @@ class ChaosProxy:
             self._conns.append(upstream)
         up_fault = fault if fault is not None and fault.direction == "up" else None
         down_fault = fault if fault is not None and fault.direction == "down" else None
-        threading.Thread(target=self._pump, args=(client, upstream, up_fault),
-                         daemon=True).start()
+        t = threading.Thread(target=self._pump,
+                             args=(client, upstream, up_fault),
+                             daemon=True, name=f"chaos-pump:{self.port}")
+        with self._lock:
+            self._threads.append(t)
+        t.start()
         self._pump(upstream, client, down_fault)
 
     def _pump(self, src: socket.socket, dst: socket.socket,

@@ -1,14 +1,62 @@
-"""Environment scrubbing for the fragile TPU-relay container.
+"""Environment helpers: the sanctioned home for ad-hoc ``DFT_*`` reads,
+plus environment scrubbing for the fragile TPU-relay container.
 
-The container reaches its TPU through a harness-owned stdio relay; when that
-relay is dead, the axon PJRT plugin (registered by a sitecustomize whenever
-``PALLAS_AXON_*`` env vars are set) blocks the first ``import jax`` forever.
-Every entry point that must run regardless of relay state (driver dryrun,
-bench fallback, tests) builds its child environment through this one helper
-so the scrub rules live in a single place.
+Knob reads (``env_flag`` / ``env_int`` / ``env_float`` / ``env_str``):
+every ``DFT_*`` knob that does not ride an ``_EnvCfg`` schema
+(utils/config.py) must be read through these helpers — graftlint's
+``env-knob-drift`` checker flags raw ``os.environ``/``getenv`` reads of
+``DFT_*`` names anywhere else, and cross-checks the knob names collected
+here (literal first arguments) against the knob reference table in
+docs/OPERATIONS.md. The boolean coercion convention matches
+``_EnvCfg.from_env`` exactly ('0'/'false'/'False'/'' are False), so the
+two read paths cannot drift.
+
+Environment scrubbing (``scrubbed_cpu_env``): the container reaches its
+TPU through a harness-owned stdio relay; when that relay is dead, the
+axon PJRT plugin (registered by a sitecustomize whenever
+``PALLAS_AXON_*`` env vars are set) blocks the first ``import jax``
+forever. Every entry point that must run regardless of relay state
+(driver dryrun, bench fallback, tests) builds its child environment
+through this one helper so the scrub rules live in a single place.
 """
 
 import os
+
+_FALSY = ("0", "false", "False", "")
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Boolean knob: unset -> ``default``; else the one _EnvCfg coercion
+    convention ('0'/'false'/'False'/'' are False, anything else True)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw not in _FALSY
+
+
+def env_int(name: str, default=None):
+    """Integer knob: unset or empty -> ``default`` (which may be None for
+    caller-computed fallbacks, e.g. cpu-count-derived pool sizes)."""
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return default
+    return int(raw)
+
+
+def env_float(name: str, default=None):
+    """Float knob: unset or empty -> ``default``."""
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return default
+    return float(raw)
+
+
+def env_str(name: str, default=None):
+    """String knob: unset or empty -> ``default``."""
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return default
+    return raw
 
 
 def scrubbed_cpu_env(n_devices=None, base_env=None, extra_pythonpath=None):

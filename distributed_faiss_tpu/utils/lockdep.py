@@ -38,6 +38,8 @@ import os
 import threading
 import traceback
 
+from distributed_faiss_tpu.utils import envutil
+
 __all__ = [
     "LockOrderError", "enabled", "lock", "rlock", "condition",
     "reset", "edges", "held",
@@ -53,7 +55,7 @@ class LockOrderError(RuntimeError):
 def enabled() -> bool:
     """DFT_LOCKDEP master switch, read at lock-creation time (so tests
     can flip it per-fixture and subprocess ranks inherit it)."""
-    return os.environ.get("DFT_LOCKDEP", "0") not in ("", "0", "false", "False")
+    return envutil.env_flag("DFT_LOCKDEP", False)
 
 
 # ---------------------------------------------------------------- graph state

@@ -1,13 +1,15 @@
 #!/usr/bin/env bash
 # Local fast-path for the checks CI runs on every push: the graftlint
-# repo lint (stdlib-only, ~seconds) plus the lint test tier (golden
-# fixtures + CLI contract). Wire it up with:
+# lint (all 13 checkers; --changed keeps it to the files you touched so
+# the growing suite stays fast at commit time — CI lints the full tree)
+# plus the lint test tier (golden fixtures + CLI contract) and the
+# runtime-witness unit tests. Wire it up with:
 #   ln -s ../../scripts/precommit.sh .git/hooks/pre-commit
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "graftlint: linting distributed_faiss_tpu/ + tools/ (all 9 checkers)"
-python -m tools.graftlint distributed_faiss_tpu tools
+echo "graftlint: linting changed files vs HEAD (all 13 checkers)"
+python -m tools.graftlint --changed
 
 echo "graftlint: lint test tier"
 JAX_PLATFORMS=cpu python -m pytest tests/test_graftlint.py -q -m lint \
@@ -16,5 +18,9 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_graftlint.py -q -m lint \
 echo "lockdep: runtime lock-order witness unit tests"
 JAX_PLATFORMS=cpu python -m pytest tests/test_lockdep.py -q \
     -m "lockdep and not slow" -p no:cacheprovider
+
+echo "threadcheck: runtime thread-leak witness unit tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_threadcheck.py -q \
+    -m "threadcheck and not slow" -p no:cacheprovider
 
 echo "precommit: OK"

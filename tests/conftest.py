@@ -32,6 +32,30 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
+from distributed_faiss_tpu.utils import threadcheck
+
+# DFT_THREADCHECK=1: wrap Thread.start once, at collection time, so every
+# thread started anywhere in the suite carries creation provenance
+if threadcheck.enabled():
+    threadcheck.install()
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_witness():
+    """DFT_THREADCHECK=1 runtime witness (utils/threadcheck.py): snapshot
+    the live-thread set around each test; a NON-DAEMON thread created
+    during the test that outlives it (past a bounded grace join) fails
+    the test with its name and creation site. Threads owned by
+    broader-scoped fixtures are in the `before` snapshot (higher-scope
+    fixtures set up first) and are exempt, which scopes the witness to
+    exactly what this test created. No-op when the knob is off."""
+    if not threadcheck.enabled():
+        yield
+        return
+    before = threadcheck.snapshot()
+    yield
+    threadcheck.check(before)
+
 
 @pytest.fixture(scope="session")
 def rng():

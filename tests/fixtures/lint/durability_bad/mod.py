@@ -1,0 +1,49 @@
+"""generation-commit bad fixture: one violation class per function."""
+
+import json
+import os
+
+from distributed_faiss_tpu.utils.serialization import (
+    atomic_write,
+    generation_filename,
+    write_manifest,
+)
+
+
+def direct_write(storage_dir, payload):
+    # line 15: open(..., 'w') straight into the storage dir
+    with open(os.path.join(storage_dir, "meta.json"), "w") as f:
+        f.write(payload)
+
+
+def sneaky_rename(index_storage_dir):
+    tmp = os.path.join(index_storage_dir, "x.tmp")
+    # line 22: un-fsync'd rename inside the storage dir
+    os.replace(tmp, os.path.join(index_storage_dir, "x.bin"))
+
+
+def dump_straight(storage_dir, obj):
+    # line 27: serializer (and an open-for-write) straight into storage
+    json.dump(obj, open(os.path.join(storage_dir, "cfg.json"), "w"))
+
+
+def rogue_commit(storage_dir, state):
+    name = generation_filename("index", 1, "npz")
+    atomic_write(os.path.join(storage_dir, name), state, "wb")
+    # line 33: a MANIFEST written outside _commit_generation
+    write_manifest(storage_dir, 1, {})
+
+
+def _commit_generation(storage_dir, state):
+    name = generation_filename("index", 2, "npz")
+    write_manifest(storage_dir, 2, {})
+    # line 40: generation data file written AFTER the manifest
+    atomic_write(os.path.join(storage_dir, name), state, "wb")
+
+
+def hand_rolled(path, data):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    # line 48: rename with no fsync between write and publish
+    os.replace(tmp, path)

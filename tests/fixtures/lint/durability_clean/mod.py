@@ -1,0 +1,47 @@
+"""generation-commit clean fixture: the sanctioned write patterns."""
+
+import json
+import os
+
+from distributed_faiss_tpu.utils.serialization import (
+    atomic_write,
+    generation_filename,
+    write_manifest,
+)
+
+
+def read_ok(storage_dir):
+    # reads are free
+    with open(os.path.join(storage_dir, "meta.json")) as f:
+        return f.read()
+
+
+def atomic_ok(storage_dir, payload):
+    # writes ride atomic_write (tmp+fsync+rename inside)
+    atomic_write(os.path.join(storage_dir, "meta.json"),
+                 lambda f: f.write(payload), "w")
+
+
+def _commit_generation(storage_dir, state, meta):
+    # data files first, MANIFEST last — the commit point
+    entries = {}
+    for key, blob in (("index", state), ("meta", meta)):
+        name = generation_filename(key, 3, "bin")
+        digest = atomic_write(os.path.join(storage_dir, name),
+                              lambda f: f.write(blob), "wb")
+        entries[key] = {"name": name, "sha256": digest}
+    write_manifest(storage_dir, 3, entries)
+    # the unversioned convenience copy is NOT a generation data file:
+    # writing it after the manifest is legal
+    atomic_write(os.path.join(storage_dir, "cfg.json"),
+                 lambda f: f.write(json.dumps({})), "w")
+
+
+def hand_rolled_ok(path, data):
+    # tmp + fsync + rename by hand is honest (atomic_write preferred)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -1,0 +1,41 @@
+"""exception-classification bad fixture: one violation class per site."""
+
+import logging
+
+logger = logging.getLogger()
+
+
+def silent_swallow(sock):
+    try:
+        return sock.recv(4)
+    # line 12: broad except, nothing raised/logged/recorded/classified
+    except Exception:
+        return None
+
+
+def ungated_retry(call):
+    while True:
+        try:
+            return call()
+        # line 21: broad except driving a retry loop
+        except Exception as e:
+            logger.warning("retrying after %s", e)
+            continue
+
+
+def bare_teardown(sock):
+    try:
+        sock.close()
+    # line 29: bare except eats SystemExit/KeyboardInterrupt
+    except:  # noqa: E722
+        pass
+
+
+# graftlint: hot
+def hot_scan(rows, out):
+    for r in rows:
+        try:
+            out.append(r.decode())
+        # line 38: swallow-and-pass on a hot-path function
+        except Exception:
+            pass

@@ -1,0 +1,59 @@
+"""exception-classification clean fixture: the sanctioned patterns."""
+
+import logging
+
+logger = logging.getLogger()
+
+TRANSPORT_ERRORS = (OSError, EOFError)
+RETRYABLE_ERRORS = TRANSPORT_ERRORS + (TimeoutError,)
+
+
+class ServerException(RuntimeError):
+    pass
+
+
+def narrow_swallow(sock):
+    try:
+        return sock.recv(4)
+    except OSError:
+        return None  # narrow class: a reviewed decision
+
+
+def gated_retry(call):
+    while True:
+        try:
+            return call()
+        except RETRYABLE_ERRORS:
+            continue  # classified: only transport-ish failures retry
+
+
+def classify(call):
+    try:
+        return call()
+    except Exception as e:
+        raise ServerException(str(e))  # re-classified into the taxonomy
+
+
+def record_outcome(call, outcomes):
+    try:
+        return call()
+    except Exception as e:
+        outcomes.append(e)  # recorded: the caller dispatches on it
+        return None
+
+
+def logged_guard(call):
+    try:
+        return call()
+    except Exception:
+        logger.exception("background pass failed")  # at minimum, logged
+        return None
+
+
+# graftlint: hot
+def hot_scan(rows, out):
+    for r in rows:
+        try:
+            out.append(r.decode())
+        except UnicodeDecodeError:
+            pass  # narrow pass on the hot path is fine

@@ -1,0 +1,8 @@
+"""env-knob-drift bad fixture: ad-hoc env read."""
+
+import os
+
+
+def read_adhoc():
+    # line 8: raw read outside utils/config.py / utils/envutil.py
+    return os.environ.get("DFT_FIX_ADHOC", "0")

@@ -1,0 +1,8 @@
+"""env-knob-drift bad fixture: the schema home."""
+
+_FIX_SCHEMA = {
+    # line 5: documented with the wrong default
+    "alpha": (int, "DFT_FIX_ALPHA", 5),
+    # line 7: no doc row at all
+    "beta": (bool, "DFT_FIX_BETA", True),
+}

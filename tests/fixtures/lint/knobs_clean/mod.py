@@ -1,0 +1,14 @@
+"""env-knob-drift clean fixture: ad-hoc knobs ride envutil helpers."""
+
+from distributed_faiss_tpu.utils import envutil
+
+
+def gamma_enabled():
+    return envutil.env_flag("DFT_FIX_GAMMA", False)
+
+
+def delta_budget():
+    # no literal default: the fallback is computed, so the doc cell is
+    # free-form and default-drift comparison skips it
+    raw = envutil.env_int("DFT_FIX_DELTA")
+    return raw if raw else 2 * 4

@@ -1,0 +1,5 @@
+"""env-knob-drift clean fixture: schema home."""
+
+_FIX_SCHEMA = {
+    "alpha": (int, "DFT_FIX_ALPHA", 5),
+}

@@ -1,0 +1,43 @@
+"""thread-lifecycle bad fixture: one violation class per site."""
+
+import _thread
+import threading
+
+
+class Orphanage:
+    def __init__(self):
+        self._named = None
+        self._implicit = None
+        self._unjoined = None
+
+    def spawn_unnamed(self):
+        # line 16: named missing (daemon explicit, tracked, joined)
+        self._named = threading.Thread(target=self._work, daemon=True)
+        self._named.start()
+
+    def spawn_implicit_daemon(self):
+        # line 21: daemon status implicit (named, tracked, joined)
+        self._implicit = threading.Thread(target=self._work, name="w")
+        self._implicit.start()
+
+    def spawn_chained(self):
+        # line 26: started and dropped — untracked orphan
+        threading.Thread(target=self._work, name="x", daemon=True).start()
+
+    def spawn_unjoined(self):
+        # line 30: tracked in self._unjoined but stop() never joins it
+        self._unjoined = threading.Thread(target=self._work, name="y",
+                                          daemon=True)
+        self._unjoined.start()
+
+    def spawn_raw(self):
+        # line 36: raw _thread spawn
+        _thread.start_new_thread(self._work, ())
+
+    def _work(self):
+        pass
+
+    def stop(self):
+        for t in (self._named, self._implicit):
+            if t is not None:
+                t.join(timeout=1.0)

@@ -1,0 +1,47 @@
+"""thread-lifecycle clean fixture: every discipline pattern in one class.
+
+Covers: direct self-attr tracking with a helper-resident join (the
+call-graph propagation from stop()), container tracking with the
+snapshot-and-swap drain idiom, local-append tracking, and a factory
+whose thread escapes to its caller.
+"""
+
+import threading
+
+
+def make_worker(target):
+    # escapes: the caller owns tracking/joining
+    t = threading.Thread(target=target, name="made", daemon=True)
+    return t
+
+
+class Crew:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pool = []
+        self._loop = threading.Thread(target=self._run, name="crew-loop",
+                                      daemon=True)
+        self._loop.start()
+
+    def hire(self):
+        t = threading.Thread(target=self._run, name="crew-worker",
+                             daemon=True)
+        with self._lock:
+            self._pool.append(t)
+        t.start()
+
+    def _run(self):
+        pass
+
+    def _drain(self):
+        # join lives in a helper: reachable from stop() through the
+        # class call graph, and the locals alias through a tuple swap
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for t in pool:
+            t.join(timeout=1.0)
+        loop = self._loop
+        loop.join(timeout=1.0)
+
+    def stop(self):
+        self._drain()

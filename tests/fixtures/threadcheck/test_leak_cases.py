@@ -1,0 +1,45 @@
+"""Doctored thread-lifecycle cases for the DFT_THREADCHECK e2e tests.
+
+Driven by tests/test_threadcheck.py in a subprocess with
+DFT_THREADCHECK=1 + DFT_THREADCHECK_E2E=1: the leak case must FAIL under
+the conftest witness fixture (proving the real wiring — install at
+collection, snapshot/check around each test — catches it), the daemon
+and joined cases must pass. The env guard keeps every normal tier from
+running them: without the driver variables they skip.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("DFT_THREADCHECK_E2E") != "1",
+    reason="doctored case: driven by tests/test_threadcheck.py subprocess")
+
+# long enough to outlive the (driver-shortened) grace join, short enough
+# that the non-daemon thread cannot hold the subprocess interpreter
+# hostage for more than a few seconds after pytest finishes
+_LINGER_S = 3.0
+
+
+def test_leaks_a_nondaemon_thread():
+    threading.Thread(target=time.sleep, args=(_LINGER_S,),
+                     name="doctored-leak", daemon=False).start()
+
+
+def test_daemon_thread_is_exempt():
+    hold = threading.Event()
+    threading.Thread(target=hold.wait, name="doctored-daemon",
+                     daemon=True).start()
+
+
+def test_tracked_and_joined_is_clean():
+    done = threading.Event()
+    t = threading.Thread(target=done.set, name="doctored-joined",
+                         daemon=False)
+    t.start()
+    assert done.wait(5.0)
+    t.join(5.0)
+    assert not t.is_alive()

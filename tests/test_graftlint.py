@@ -226,6 +226,148 @@ def test_suppression_silences_bad_fixture(tmp_path):
         ("pickle-safety", 5), ("pickle-safety", 13), ("pickle-safety", 18)]
 
 
+def test_threads_bad_fixture():
+    assert _locs(_lint(f"{FIX}/threads_bad")) == [
+        ("thread-lifecycle", 15),  # unnamed
+        ("thread-lifecycle", 20),  # daemon status implicit
+        ("thread-lifecycle", 25),  # chained start, untracked
+        ("thread-lifecycle", 29),  # tracked but no join path from stop()
+        ("thread-lifecycle", 35),  # raw _thread.start_new_thread
+    ]
+
+
+def test_threads_clean_fixture():
+    # exercises: helper-resident join reachable from stop(), tuple-swap
+    # drain aliasing, container tracking, escaping factory thread
+    assert _lint(f"{FIX}/threads_clean") == []
+
+
+def test_durability_bad_fixture():
+    assert _locs(_lint(f"{FIX}/durability_bad")) == [
+        ("generation-commit", 15),  # open(..., 'w') into storage
+        ("generation-commit", 22),  # raw rename inside storage
+        ("generation-commit", 27),  # json.dump straight into storage...
+        ("generation-commit", 27),  # ...via an inline open('w')
+        ("generation-commit", 34),  # MANIFEST outside _commit_generation
+        ("generation-commit", 41),  # data file written after the MANIFEST
+        ("generation-commit", 49),  # tmp+rename without fsync
+    ]
+
+
+def test_durability_clean_fixture():
+    # post-manifest cfg.json convenience copy and a hand-rolled
+    # tmp+fsync+rename are both sanctioned
+    assert _lint(f"{FIX}/durability_clean") == []
+
+
+def test_knobs_bad_fixture():
+    locs = sorted((f.rule, os.path.basename(f.path), f.line)
+                  for f in _lint(f"{FIX}/knobs_bad"))
+    assert locs == [
+        ("env-knob-drift", "OPERATIONS.md", 6),  # default drift (7 vs 5)
+        ("env-knob-drift", "OPERATIONS.md", 7),  # stale doc knob
+        ("env-knob-drift", "config.py", 7),      # undocumented code knob
+        ("env-knob-drift", "mod.py", 8),         # ad-hoc env read
+    ]
+
+
+def test_knobs_clean_fixture():
+    # schema + envutil knobs documented, computed default skipped
+    assert _lint(f"{FIX}/knobs_clean") == []
+
+
+def test_exceptions_bad_fixture():
+    assert _locs(_lint(f"{FIX}/exceptions_bad")) == [
+        ("exception-classification", 12),  # silent broad swallow
+        ("exception-classification", 21),  # broad except driving a retry
+        ("exception-classification", 30),  # bare except
+        ("exception-classification", 40),  # hot-path swallow-and-pass
+    ]
+
+
+def test_exceptions_clean_fixture():
+    # narrow classes, RETRYABLE_ERRORS-gated retry, re-classification,
+    # outcome recording, logged guards, narrow hot-path pass
+    assert _lint(f"{FIX}/exceptions_clean") == []
+
+
+# ------------------------------------------------------- suppression audit
+
+def test_stale_suppression_is_flagged(tmp_path):
+    """The rot audit: an ok() that suppresses nothing is itself a
+    finding; one that earns its keep is not."""
+    p = tmp_path / "parallel"
+    p.mkdir()
+    (p / "mod.py").write_text(
+        "import pickle\n"
+        "\n"
+        "\n"
+        "def live(raw):\n"
+        "    # graftlint: ok(pickle-safety): fixture waiver\n"
+        "    return pickle.loads(raw)\n"
+        "\n"
+        "\n"
+        "def stale(x):\n"
+        "    return x + 1  # graftlint: ok(host-sync): nothing here\n"
+    )
+    findings = lint_paths([str(p / "mod.py")])
+    assert [(f.rule, f.line) for f in findings] == [
+        ("unused-suppression", 10)]
+    assert "ok(host-sync)" in findings[0].message
+
+
+def test_unknown_rule_suppression_is_flagged(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "X = 1  # graftlint: ok(pickel-safety): typo'd rule\n")
+    findings = lint_paths([str(tmp_path / "m.py")])
+    assert [(f.rule, f.line) for f in findings] == [
+        ("unused-suppression", 1)]
+    assert "unknown rule" in findings[0].message
+
+
+def test_dormant_waiver_opt_out(tmp_path):
+    """ok(unused-suppression) beside a deliberately-dormant waiver
+    silences the audit for it — and is itself counted as used."""
+    (tmp_path / "m.py").write_text(
+        "# graftlint: ok(unused-suppression): version-gated path below\n"
+        "X = 1  # graftlint: ok(host-sync): fires only on jax<0.4\n")
+    assert lint_paths([str(tmp_path / "m.py")]) == []
+
+
+def test_orphaned_dormant_waiver_marker_is_flagged(tmp_path):
+    """The opt-out marker is itself audited: one whose waived neighbor
+    was deleted is rot too — the audit's escape hatch cannot be the one
+    place rot accumulates."""
+    (tmp_path / "m.py").write_text(
+        "# graftlint: ok(unused-suppression): covered a waiver, now gone\n"
+        "X = 1\n")
+    findings = lint_paths([str(tmp_path / "m.py")])
+    assert [(f.rule, f.line) for f in findings] == [
+        ("unused-suppression", 1)]
+    assert "orphaned" in findings[0].message
+
+
+def test_subset_lint_skips_cross_artifact_rules():
+    """subset=True (the --changed path) gates off the rot audit and the
+    knob/doc cross-check: a config.py-only changed set must not flag
+    every knob whose reader wasn't in the subset as a stale doc row, and
+    a suppression whose finding resolves through unlinted modules must
+    not read as stale."""
+    config = os.path.join("distributed_faiss_tpu", "utils", "config.py")
+    engine = os.path.join("distributed_faiss_tpu", "engine.py")
+    assert lint_paths([config], subset=True) == []
+    assert lint_paths([engine], subset=True) == []
+
+
+def test_docstring_mentions_are_not_suppressions(tmp_path):
+    """The ok()/hot syntax quoted inside a string literal neither
+    suppresses nor trips the audit (comment-token scanning)."""
+    (tmp_path / "m.py").write_text(
+        '"""Docs: use ``# graftlint: ok(host-sync)`` to waive."""\n'
+        "X = 1\n")
+    assert lint_paths([str(tmp_path / "m.py")]) == []
+
+
 # ---------------------------------------------------------- self-enforcing
 
 def test_repo_is_lint_clean():
@@ -265,12 +407,65 @@ def test_cli_list_rules():
     assert proc.returncode == 0
     for rule in ("host-sync", "recompile-hazard", "dtype-discipline",
                  "lock-discipline", "lock-order", "blocking-under-lock",
-                 "frame-protocol", "pallas-guard", "pickle-safety"):
+                 "frame-protocol", "pallas-guard", "pickle-safety",
+                 "thread-lifecycle", "generation-commit", "env-knob-drift",
+                 "exception-classification"):
         assert rule in proc.stdout
 
 
-def test_all_nine_checkers_registered():
+def test_all_thirteen_checkers_registered():
     from tools.graftlint import checks
 
-    assert len(checks.ALL) == 9
-    assert len(checks.RULES) == 9
+    assert len(checks.ALL) == 13
+    assert len(checks.RULES) == 13
+
+
+def test_cli_changed_mode(tmp_path):
+    """--changed lints exactly the files touched vs HEAD (plus
+    untracked) under the default paths, in a scratch git repo."""
+    import shutil
+
+    repo = tmp_path / "repo"
+    pkg = repo / "distributed_faiss_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "clean.py").write_text("X = 1\n")
+    env = dict(os.environ, PYTHONPATH=REPO,
+               GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=repo, env=env, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+
+    def changed(*args, cwd=repo):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--changed", *args],
+            cwd=cwd, env=env, capture_output=True, text=True, timeout=120)
+
+    proc = changed()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no changed files" in proc.stdout
+
+    # an untracked bad file under the default paths is picked up (in
+    # parallel/ so the path-scoped pickle-safety rule applies to it)
+    (pkg / "parallel").mkdir()
+    shutil.copy(os.path.join(REPO, FIX, "parallel", "pickle_bad.py"),
+                pkg / "parallel" / "bad.py")
+    proc = changed()
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "pickle-safety" in proc.stdout
+
+    # ...from a subdirectory too: git emits repo-root-relative names, so
+    # a cwd-relative resolve would silently lint nothing and false-pass
+    proc = changed(cwd=pkg)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "pickle-safety" in proc.stdout
+
+    # ...and removing it returns to exit 0
+    (pkg / "parallel" / "bad.py").unlink()
+    proc = changed()
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -278,6 +278,7 @@ def test_mesh_engine_torn_generation_falls_back(tmp_path, rng):
     fallback the chaos gate relies on)."""
     from distributed_faiss_tpu.engine import Index
     from distributed_faiss_tpu.utils import serialization
+    from distributed_faiss_tpu.utils.state import IndexState
 
     storage = tmp_path / "shard"
     idx, x = _trained_mesh_engine(storage, rng)
@@ -285,12 +286,15 @@ def test_mesh_engine_torn_generation_falls_back(tmp_path, rng):
     golden = idx.search_batched(q, 3)
     assert idx.save()
 
-    # a second, newer generation...
+    # a second, newer generation... (wait for the state flip too: the
+    # drain worker zeroes the buffer count BEFORE leaving ADD, and
+    # save() during ADD defers to add-completion and returns None)
     extra = rng.standard_normal((40, 16)).astype(np.float32)
     idx.add_batch(extra, [("m", 600 + i) for i in range(40)],
                   train_async_if_triggered=False)
     deadline = time.time() + 60
-    while idx.get_idx_data_num()[0] > 0:
+    while (idx.get_state() != IndexState.TRAINED
+           or idx.get_idx_data_num()[0] > 0):
         assert time.time() < deadline
         time.sleep(0.02)
     assert idx.save()

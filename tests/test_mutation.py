@@ -55,13 +55,19 @@ def build_engine(tmp_path, rng, n=200, **kw):
 
 
 def wait_drained(idx, n, timeout=30.0):
+    from distributed_faiss_tpu.utils.state import IndexState
+
     deadline = time.time() + timeout
     while time.time() < deadline:
-        if idx.get_idx_data_num() == (0, n):
+        # wait for the ADD->TRAINED flip too: the drain worker zeroes
+        # the buffer count BEFORE leaving ADD, and a test that then
+        # forces/reads engine state would race the worker's final flip
+        if (idx.get_idx_data_num() == (0, n)
+                and idx.get_state() == IndexState.TRAINED):
             return
         time.sleep(0.02)
     raise AssertionError(f"engine never drained to {n} rows: "
-                         f"{idx.get_idx_data_num()}")
+                         f"{idx.get_idx_data_num()} ({idx.get_state()})")
 
 
 # ------------------------------------------------------------ model layer

@@ -445,8 +445,13 @@ def test_retired_engine_never_autosaves_again(tmp_path):
     idx.add_batch(rng.standard_normal((20, 8)).astype(np.float32),
                   [(i,) for i in range(20)], train_async_if_triggered=False)
     import time
+
+    from distributed_faiss_tpu.utils.state import IndexState
     deadline = time.time() + 30
-    while idx.get_idx_data_num()[0] > 0:
+    # wait for the ADD->TRAINED flip too (the drain worker zeroes the
+    # count first, and save() during ADD defers and returns None)
+    while (idx.get_idx_data_num()[0] > 0
+           or idx.get_state() != IndexState.TRAINED):
         assert time.time() < deadline
         time.sleep(0.02)
     assert idx.save()

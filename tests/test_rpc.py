@@ -397,6 +397,12 @@ def test_restricted_loads_rejects_dangerous_globals(monkeypatch):
                 + b"\x93.")
     with pytest.raises(pickle.UnpicklingError, match="disallowed"):
         rpc.restricted_loads(evil_pkg)
+    # the opt-out is strictly '1': truthy-but-wrong spellings must stay
+    # on the restricted path (a security knob never widens by coercion)
+    for spelling in ("true", "yes", "2", "on"):
+        monkeypatch.setenv("DFT_RPC_UNSAFE_PICKLE", spelling)
+        with pytest.raises(pickle.UnpicklingError, match="disallowed"):
+            rpc.restricted_loads(blob)
     # explicit opt-out restores reference behavior for custom metadata
     monkeypatch.setenv("DFT_RPC_UNSAFE_PICKLE", "1")
     assert rpc.restricted_loads(blob) == os.getenv("HOME")

@@ -1,0 +1,250 @@
+"""Runtime thread-leak witness tests (marker ``threadcheck``; the
+subprocess tier re-runs are additionally ``slow``).
+
+Unit layer: the DFT_THREADCHECK=1 witness (utils/threadcheck.py)
+detects a leaked non-daemon thread and names its creation site, exempts
+daemon threads, passes tracked-and-joined workers, grants a bounded
+grace join to winding-down workers, and is a no-op when disabled.
+
+E2e layer: a subprocess pytest run over the doctored cases in
+tests/fixtures/threadcheck/ proves the REAL wiring — conftest installs
+the wrapper at collection, the autouse fixture snapshots/checks around
+each test — fails a leaking test and passes the daemon/joined ones.
+
+Tier layer (``pytest -m threadcheck``, mirrored by the ci.yml
+``threadcheck`` job): re-run the scheduler, replication, anti-entropy,
+and mutation suites with the witness on — the dynamic complement of
+graftlint's static thread-lifecycle checker, exactly as lockdep is to
+the static lock-order checker.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from distributed_faiss_tpu.utils import threadcheck
+
+pytestmark = pytest.mark.threadcheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def witness(monkeypatch):
+    """DFT_THREADCHECK=1 with the start-wrapper installed; restores the
+    unwrapped Thread.start afterwards unless an outer tier (the
+    threadcheck CI job runs this file with the env set globally) already
+    owned the installation."""
+    monkeypatch.setenv("DFT_THREADCHECK", "1")
+    owned = threadcheck._ORIG_START is None
+    threadcheck.install()
+    yield
+    if owned:
+        threadcheck.uninstall()
+
+
+# ------------------------------------------------------------------ switch
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("DFT_THREADCHECK", raising=False)
+    assert not threadcheck.enabled()
+
+
+def test_enabled_reads_env(witness):
+    assert threadcheck.enabled()
+
+
+def test_install_is_idempotent_and_uninstall_restores():
+    was_installed = threadcheck._ORIG_START is not None
+    threadcheck.uninstall()  # clean slate even under the global tier
+    orig = threading.Thread.start
+    try:
+        threadcheck.install()
+        wrapped = threading.Thread.start
+        assert wrapped is not orig
+        threadcheck.install()  # second install must not double-wrap
+        assert threading.Thread.start is wrapped
+        threadcheck.uninstall()
+        assert threading.Thread.start is orig
+        threadcheck.uninstall()  # idempotent too
+        assert threading.Thread.start is orig
+    finally:
+        if was_installed:
+            threadcheck.install()
+
+
+# --------------------------------------------------------------- leak check
+
+def test_leak_detected_with_name_and_site(witness):
+    """A non-daemon thread created after the snapshot that outlives the
+    grace join raises ThreadLeakError naming the thread AND the
+    file:line that started it."""
+    before = threadcheck.snapshot()
+    hold = threading.Event()
+    t = threading.Thread(target=hold.wait, name="leaky-worker",
+                         daemon=False)
+    t.start()
+    with pytest.raises(threadcheck.ThreadLeakError) as exc:
+        threadcheck.check(before, grace_s=0.2)
+    msg = str(exc.value)
+    assert "leaky-worker" in msg
+    assert "test_threadcheck.py:" in msg
+    hold.set()
+    t.join(5.0)
+    assert not t.is_alive()
+
+
+def test_daemon_threads_are_exempt(witness):
+    before = threadcheck.snapshot()
+    hold = threading.Event()
+    t = threading.Thread(target=hold.wait, name="daemon-worker",
+                         daemon=True)
+    t.start()
+    threadcheck.check(before, grace_s=0.2)  # must not raise
+    hold.set()
+    t.join(5.0)
+
+
+def test_tracked_and_joined_is_clean(witness):
+    before = threadcheck.snapshot()
+    done = threading.Event()
+    t = threading.Thread(target=done.set, name="joined-worker",
+                         daemon=False)
+    t.start()
+    assert done.wait(5.0)
+    t.join(5.0)
+    threadcheck.check(before)  # must not raise
+    assert threadcheck.leaked(before, grace_s=0.0) == []
+
+
+def test_grace_join_absorbs_winding_down_worker(witness):
+    """A non-daemon worker that finishes within the grace window is not
+    a leak: stop()-then-return teardown patterns must not flake."""
+    before = threadcheck.snapshot()
+    t = threading.Thread(target=time.sleep, args=(0.3,),
+                         name="winding-down", daemon=False)
+    t.start()
+    threadcheck.check(before, grace_s=5.0)  # joins it inside the grace
+    assert not t.is_alive()
+
+
+def test_preexisting_threads_are_exempt(witness):
+    """Threads already alive at snapshot time (session-scoped fixtures,
+    executors owned by a broader scope) are never this scope's leak."""
+    hold = threading.Event()
+    t = threading.Thread(target=hold.wait, name="outer-scope",
+                         daemon=False)
+    t.start()
+    before = threadcheck.snapshot()
+    assert threadcheck.leaked(before, grace_s=0.1) == []
+    hold.set()
+    t.join(5.0)
+
+
+def test_grace_default_comes_from_env(witness, monkeypatch):
+    monkeypatch.setenv("DFT_THREADCHECK_GRACE_S", "0.25")
+    assert threadcheck._default_grace() == 0.25
+    before = threadcheck.snapshot()
+    hold = threading.Event()
+    t = threading.Thread(target=hold.wait, name="env-grace",
+                         daemon=False)
+    t.start()
+    t0 = time.monotonic()
+    with pytest.raises(threadcheck.ThreadLeakError):
+        threadcheck.check(before)  # grace resolved from the env knob
+    assert time.monotonic() - t0 < 3.0
+    hold.set()
+    t.join(5.0)
+
+
+# ---------------------------------------------------------------- provenance
+
+def test_provenance_recorded_for_wrapped_start(witness):
+    t = threading.Thread(target=lambda: None, name="prov", daemon=True)
+    t.start()
+    t.join(5.0)
+    assert threadcheck.provenance(t).startswith("test_threadcheck.py:")
+
+
+def test_unwitnessed_start_has_placeholder_provenance():
+    was_installed = threadcheck._ORIG_START is not None
+    threadcheck.uninstall()
+    try:
+        t = threading.Thread(target=lambda: None, name="bare", daemon=True)
+        t.start()
+        t.join(5.0)
+        assert threadcheck.provenance(t) == "<unwitnessed start>"
+    finally:
+        if was_installed:  # the threadcheck tier installs globally at
+            threadcheck.install()  # collection: leave it as we found it
+
+
+# ----------------------------------------------------------------------- e2e
+
+def _run_doctored(case: str):
+    """Run one doctored case under the real conftest wiring with a short
+    grace so the leak case fails fast."""
+    env = dict(os.environ, DFT_THREADCHECK="1", DFT_THREADCHECK_E2E="1",
+               DFT_THREADCHECK_GRACE_S="0.5", JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "pytest",
+         f"tests/fixtures/threadcheck/test_leak_cases.py::{case}",
+         "-q", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+
+
+def test_e2e_conftest_fixture_fails_leaking_test():
+    proc = _run_doctored("test_leaks_a_nondaemon_thread")
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "ThreadLeakError" in proc.stdout
+    assert "doctored-leak" in proc.stdout
+    assert "test_leak_cases.py:" in proc.stdout  # creation provenance
+
+
+def test_e2e_daemon_and_joined_cases_pass():
+    for case in ("test_daemon_thread_is_exempt",
+                 "test_tracked_and_joined_is_clean"):
+        proc = _run_doctored(case)
+        assert proc.returncode == 0, (
+            f"{case} failed under the witness:\n"
+            f"{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}")
+
+
+def test_e2e_cases_skip_without_driver_env(monkeypatch):
+    """The doctored file must never run in normal tiers: without the
+    driver env its tests skip (so a plain `pytest tests/` cannot trip
+    over a deliberate leak)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DFT_THREADCHECK_E2E", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/fixtures/threadcheck/test_leak_cases.py",
+         "-q", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "3 skipped" in proc.stdout
+
+
+# ------------------------------------------------------------------ the tier
+
+@pytest.mark.slow
+def test_threaded_suites_under_witness():
+    """The threadcheck-tier satellite (mirrors the lockdep tier): re-run
+    the scheduler, replication, anti-entropy, and mutation fast suites
+    with DFT_THREADCHECK=1 — every test that starts a non-daemon thread
+    and does not join it fails with the thread's creation site."""
+    env = dict(os.environ, DFT_THREADCHECK="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_scheduler.py", "tests/test_scheduler_identity.py",
+         "tests/test_replication.py", "tests/test_mutation.py",
+         "tests/test_antientropy.py",
+         "-q", "-m", "not slow", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=2400)
+    assert proc.returncode == 0, (
+        f"threadcheck tier failed:\n{proc.stdout[-6000:]}\n"
+        f"{proc.stderr[-2000:]}")

@@ -14,6 +14,6 @@ hot-path/lock annotation conventions are documented in docs/LINTING.md.
 
 from tools.graftlint.core import Finding, lint_paths  # noqa: F401
 
-__version__ = "0.2.0"  # 0.2: concurrency suite (lock-order, blocking-under-lock, frame-protocol)
+__version__ = "0.3.0"  # 0.3: lifecycle & durability discipline (thread-lifecycle, generation-commit, env-knob-drift, exception-classification) + suppression-rot audit + --changed
 
 DEFAULT_PATHS = ("distributed_faiss_tpu", "tools")

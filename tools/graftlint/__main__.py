@@ -1,5 +1,12 @@
 """CLI: ``python -m tools.graftlint [paths] [--format=text|json]``.
 
+``--changed`` lints only the tracked-and-modified (plus untracked) .py
+files under the default paths — ``git diff --name-only HEAD`` — which is
+what scripts/precommit.sh runs so the growing checker suite stays fast
+at commit time. Cross-artifact rules that need the whole package (the
+PINS audit, the knob/doc drift check) gate themselves off on subsets;
+CI still runs the full lint.
+
 Exit status: 0 when clean, 1 when findings, 2 on usage errors. Runs
 standalone (stdlib-only: ast) and under tier-1 via tests/test_graftlint.py
 (the self-enforcing lint of the whole repo, marked ``lint``).
@@ -7,10 +14,44 @@ standalone (stdlib-only: ast) and under tier-1 via tests/test_graftlint.py
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 
 from tools.graftlint import DEFAULT_PATHS, __version__, lint_paths
 from tools.graftlint import checks
+
+
+def changed_files(paths=DEFAULT_PATHS):
+    """Modified-vs-HEAD plus untracked .py files under ``paths``,
+    as paths joined to the repo toplevel — ``git diff --name-only``
+    emits repo-root-relative names, so resolving them against the cwd
+    would silently lint nothing (and false-pass) when invoked from a
+    subdirectory."""
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         capture_output=True, text=True)
+    if top.returncode != 0:
+        raise RuntimeError(
+            f"--changed needs a git checkout: {top.stderr.strip()}")
+    root = top.stdout.strip()
+    out = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        # cwd=root: ls-files --others is otherwise cwd-relative AND
+        # restricted to the cwd subtree
+        proc = subprocess.run(cmd, capture_output=True, text=True, cwd=root)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"--changed needs a git checkout: {proc.stderr.strip()}")
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    prefixes = tuple(p.rstrip("/") + "/" for p in paths)
+    return sorted(
+        os.path.join(root, f) for f in out
+        if f.endswith(".py") and (f.startswith(prefixes)
+                                  or f in paths))
 
 
 def main(argv=None) -> int:
@@ -25,6 +66,10 @@ def main(argv=None) -> int:
     parser.add_argument("--format", choices=("text", "json"), default="text")
     parser.add_argument("--list-rules", action="store_true",
                         help="print rule names and exit")
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="lint only files touched vs git HEAD (plus untracked) under "
+             "the default paths — the precommit fast path")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -33,7 +78,21 @@ def main(argv=None) -> int:
             print(f"{rule}: {doc}")
         return 0
 
-    findings = lint_paths(args.paths)
+    if args.changed:
+        try:
+            targets = changed_files()
+        except RuntimeError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+        if not targets:
+            print("graftlint: no changed files under "
+                  f"{' '.join(DEFAULT_PATHS)} — nothing to lint")
+            return 0
+        # subset lint: the rot audit and the knob/doc cross-check gate
+        # themselves off (only decidable against the full package)
+        findings = lint_paths(targets, subset=True)
+    else:
+        findings = lint_paths(args.paths)
     if args.format == "json":
         print(json.dumps(
             {

@@ -3,16 +3,21 @@
 from tools.graftlint.checks import (
     blocking,
     dtype,
+    durability,
+    exceptions,
     frame_protocol,
     host_sync,
+    knobs,
     lock_order,
     locks,
     pallas_guard,
     pickle_safety,
     recompile,
+    threads,
 )
 
 ALL = (host_sync, recompile, dtype, locks, lock_order, blocking,
-       frame_protocol, pallas_guard, pickle_safety)
+       frame_protocol, pallas_guard, pickle_safety, threads, durability,
+       knobs, exceptions)
 
 RULES = {c.RULE: c for c in ALL}

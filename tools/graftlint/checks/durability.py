@@ -1,0 +1,242 @@
+"""generation-commit: storage-dir writes ride the atomic commit protocol.
+
+A shard's storage dir is crash-safe only because every byte that lands
+in it flows through ``serialization.atomic_write`` (tmp + fsync +
+rename) and every generation becomes loadable only when its MANIFEST —
+written LAST — commits it (utils/serialization.py, engine.py
+``_commit_generation``). One direct ``open(..., 'w')`` into a storage
+path reintroduces the reference system's torn-checkpoint bug the whole
+layer exists to kill. This checker proves the discipline statically:
+
+- **direct writes** — ``open(path, 'w'/'wb'/'a'/...)``, ``os.rename`` /
+  ``os.replace``, and direct serializer dumps (``json.dump`` /
+  ``pickle.dump`` / ``np.savez``) on a storage-tainted path are
+  findings. Taint is name-based and precision-first: ``storage_dir`` /
+  ``index_storage_dir`` parameters and attributes seed it, locals
+  assigned from tainted expressions (``os.path.join(storage_dir, ...)``)
+  propagate it.
+- **one commit point** — ``serialization.write_manifest`` may be called
+  only from ``_commit_generation`` (the shared protocol): a second
+  manifest writer is a second, unreviewed definition of "committed".
+- **MANIFEST last** — inside a committing function, no generation data
+  file (an ``atomic_write`` whose path rides ``generation_filename``)
+  may be written after the ``write_manifest`` call; the manifest IS the
+  commit point, so anything after it is outside the crash contract.
+- **fsync-before-rename** — a hand-rolled tmp-then-rename (``open(tmp,
+  ...)`` then ``os.replace(tmp, dst)`` in one function) must ``fsync``
+  between write and rename, or a power cut publishes a name whose bytes
+  never hit the platter.
+
+``utils/serialization.py`` itself is exempt from the sink rules (it IS
+the sanctioned layer — quarantine renames, manifest writes) but not from
+the fsync-ordering rule, which is how ``atomic_write`` stays honest.
+"""
+
+import ast
+import os
+
+from tools.graftlint.core import Finding, call_name, dotted
+
+RULE = "generation-commit"
+
+_TAINT_NAMES = frozenset({"storage_dir", "index_storage_dir"})
+_SERIALIZERS = frozenset({"dump", "savez", "savez_compressed", "save"})
+_SERIALIZER_ROOTS = frozenset({"json", "pickle", "np", "numpy"})
+
+
+def _is_exempt(mod) -> bool:
+    return mod.relpath.endswith("utils/serialization.py")
+
+
+def _seed_tainted(node) -> bool:
+    if isinstance(node, ast.Name) and node.id in _TAINT_NAMES:
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in _TAINT_NAMES:
+        return True
+    return False
+
+
+def _local_taint(fn_node) -> set:
+    """Local names carrying a storage path, to a fixpoint: seeds are the
+    taint-named parameters/attributes; ``v = <expr over tainted>``
+    propagates."""
+    tainted = set()
+    args = getattr(fn_node, "args", None)
+    if args is not None:
+        for a in (args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            if a is not None and a.arg in _TAINT_NAMES:
+                tainted.add(a.arg)
+    for _ in range(3):
+        grew = False
+        for sub in ast.walk(fn_node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            rhs_tainted = any(
+                _seed_tainted(n) or (isinstance(n, ast.Name)
+                                     and n.id in tainted)
+                for n in ast.walk(sub.value))
+            if not rhs_tainted:
+                continue
+            for t in sub.targets:
+                if isinstance(t, ast.Name) and t.id not in tainted:
+                    tainted.add(t.id)
+                    grew = True
+        if not grew:
+            break
+    return tainted
+
+
+def _expr_tainted(expr, local_taint) -> bool:
+    for n in ast.walk(expr):
+        if _seed_tainted(n):
+            return True
+        if isinstance(n, ast.Name) and n.id in local_taint:
+            return True
+    return False
+
+
+def _write_mode(call: ast.Call):
+    """The literal mode string of an ``open`` call when it writes, else
+    None (missing mode = 'r'; non-literal modes are invisible by
+    design — precision over recall)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return None
+    return mode.value if any(c in mode.value for c in "wax+") else None
+
+
+def _uses_generation_filename(call: ast.Call, genfile_locals) -> bool:
+    for n in ast.walk(call):
+        if isinstance(n, ast.Call) and call_name(n) == "generation_filename":
+            return True
+        if isinstance(n, ast.Name) and n.id in genfile_locals:
+            return True
+    return False
+
+
+def check(model):
+    for mod in model.modules:
+        exempt = _is_exempt(mod)
+        for fi in mod.functions:
+            taint = _local_taint(fi.node)
+            manifest_line = None
+            genfile_locals = set()
+            # locals assigned from generation_filename(...) — the names
+            # of generation data files (MANIFEST-last ordering)
+            for sub in ast.walk(fi.node):
+                if isinstance(sub, ast.Assign) and isinstance(
+                        sub.value, ast.Call):
+                    if call_name(sub.value) == "generation_filename":
+                        for t in sub.targets:
+                            if isinstance(t, ast.Name):
+                                genfile_locals.add(t.id)
+
+            calls = [n for n in ast.walk(fi.node) if isinstance(n, ast.Call)]
+            for call in calls:
+                if call_name(call) == "write_manifest":
+                    if manifest_line is None or call.lineno < manifest_line:
+                        manifest_line = call.lineno
+                    if not exempt and fi.name != "_commit_generation":
+                        yield Finding(
+                            RULE, mod.relpath, call.lineno, call.col_offset,
+                            f"{fi.qualname} writes a MANIFEST directly — "
+                            "generations commit only through the shared "
+                            "_commit_generation protocol",
+                        )
+
+            for call in calls:
+                name = call_name(call)
+                d = dotted(call.func)
+
+                # MANIFEST-last ordering (applies wherever manifests are
+                # written, including _commit_generation itself)
+                if (manifest_line is not None and name == "atomic_write"
+                        and call.lineno > manifest_line
+                        and _uses_generation_filename(call, genfile_locals)):
+                    yield Finding(
+                        RULE, mod.relpath, call.lineno, call.col_offset,
+                        f"{fi.qualname} writes a generation data file "
+                        "AFTER write_manifest — the manifest is the commit "
+                        "point and must land last",
+                    )
+
+                if exempt:
+                    continue
+
+                if name == "open":
+                    mode = _write_mode(call)
+                    if mode and call.args and _expr_tainted(
+                            call.args[0], taint):
+                        yield Finding(
+                            RULE, mod.relpath, call.lineno, call.col_offset,
+                            f"{fi.qualname} opens a storage-dir path with "
+                            f"mode {mode!r} directly — route the write "
+                            "through serialization.atomic_write "
+                            "(tmp+fsync+rename) and commit via "
+                            "_commit_generation",
+                        )
+                elif d in ("os.rename", "os.replace"):
+                    if any(_expr_tainted(a, taint) for a in call.args):
+                        yield Finding(
+                            RULE, mod.relpath, call.lineno, call.col_offset,
+                            f"{fi.qualname} renames inside a storage dir "
+                            "directly — only serialization.atomic_write's "
+                            "fsync'd rename (or the quarantine helpers) "
+                            "may move files there",
+                        )
+                elif (name in _SERIALIZERS and isinstance(
+                        call.func, ast.Attribute)
+                        and call.func.value is not None
+                        and isinstance(call.func.value, ast.Name)
+                        and call.func.value.id in _SERIALIZER_ROOTS):
+                    if any(_expr_tainted(a, taint) for a in call.args):
+                        yield Finding(
+                            RULE, mod.relpath, call.lineno, call.col_offset,
+                            f"{fi.qualname} serializes straight into a "
+                            "storage-dir path — wrap the write in "
+                            "serialization.atomic_write so a crash can "
+                            "never publish a torn file",
+                        )
+
+            yield from _check_fsync_ordering(mod, fi)
+
+
+def _check_fsync_ordering(mod, fi):
+    """Hand-rolled tmp-then-rename: ``open(T, ...)`` followed by
+    ``os.replace(T, ...)``/``os.rename(T, ...)`` on the same local name
+    needs an ``os.fsync`` between write and rename."""
+    opens = {}     # local name -> first open line
+    fsync_lines = []
+    renames = []   # (local name, line, col)
+    for sub in ast.walk(fi.node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = call_name(sub)
+        d = dotted(sub.func)
+        if name == "open" and sub.args and isinstance(sub.args[0], ast.Name):
+            opens.setdefault(sub.args[0].id, sub.lineno)
+        elif d == "os.fsync":
+            fsync_lines.append(sub.lineno)
+        elif d in ("os.replace", "os.rename") and sub.args and isinstance(
+                sub.args[0], ast.Name):
+            renames.append((sub.args[0].id, sub.lineno, sub.col_offset))
+    for local, line, col in renames:
+        open_line = opens.get(local)
+        if open_line is None or open_line > line:
+            continue
+        if any(open_line <= fl <= line for fl in fsync_lines):
+            continue
+        yield Finding(
+            RULE, mod.relpath, line, col,
+            f"{fi.qualname} renames `{local}` into place without an "
+            "os.fsync between write and rename — a power cut can publish "
+            "a name whose bytes never reached disk; use "
+            "serialization.atomic_write",
+        )

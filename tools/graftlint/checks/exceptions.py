@@ -1,0 +1,150 @@
+"""exception-classification: broad excepts on RPC paths must classify.
+
+The retry/reroute/failover machinery (parallel/) is driven entirely by
+exception CLASS: ``TRANSPORT_ERRORS`` retry and fail over,
+``RETRYABLE_ERRORS`` adds BUSY backpressure, ``ServerException`` is an
+application error that must never trigger failover. A broad ``except
+Exception`` that silently swallows on one of these paths erases the
+signal the whole layer dispatches on — a dead peer looks like a healthy
+no-op. Scoped to ``parallel/`` modules, this checker flags:
+
+- **bare excepts** — ``except:`` catches ``SystemExit`` /
+  ``KeyboardInterrupt``; a serving loop that eats those cannot be shut
+  down. Only acceptable when the handler re-raises.
+- **silent broad swallows** — an ``except Exception`` /
+  ``except BaseException`` handler that neither raises, nor references
+  the caught exception (recording it into an outcome/error structure is
+  classification), nor names a classification surface
+  (``TRANSPORT_ERRORS`` / ``RETRYABLE_ERRORS`` / ``ServerException`` /
+  ``MultiRankError`` / a ``classify`` helper), nor at minimum logs it
+  (``logger.exception/error/warning``). Deliberate duck-typing probes
+  carry ``# graftlint: ok(exception-classification): <reason>``.
+- **ungated retries** — a broad handler whose body ``continue``s a
+  retry loop: retrying on *everything* turns a deterministic application
+  error into an infinite loop; gate the except on ``RETRYABLE_ERRORS``
+  (or ``TRANSPORT_ERRORS`` + the specific classes the loop can heal).
+- **hot-path swallow-and-pass** — a broad ``except: pass`` inside a
+  function on the serving hot path (the core hot-walk) is a silent
+  wrong-answer generator under load.
+"""
+
+import ast
+
+from tools.graftlint.core import Finding
+
+RULE = "exception-classification"
+
+_BROAD = frozenset({"Exception", "BaseException"})
+
+# referencing any of these in a handler body counts as classification:
+# the exception is being sorted into the wire taxonomy, not swallowed
+_CLASSIFIERS = frozenset({
+    "TRANSPORT_ERRORS", "RETRYABLE_ERRORS", "ServerException",
+    "MultiRankError", "QuorumError", "BusyError", "FrameError",
+    "ClientExit", "DeadlineExceeded",
+})
+
+_LOG_METHODS = frozenset({"exception", "error", "warning"})
+
+
+def _in_scope(mod) -> bool:
+    rel = mod.relpath
+    return "/parallel/" in rel or rel.startswith("parallel/")
+
+
+def _terminal_name(node):
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _is_broad(handler) -> bool:
+    if handler.type is None:
+        return True
+    return _terminal_name(handler.type) in _BROAD
+
+
+def _body_traits(handler):
+    traits = {
+        "raise": False, "log": False, "refs_exc": False,
+        "classifier": False, "continue": False,
+    }
+    for sub in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(sub, ast.Raise):
+            traits["raise"] = True
+        elif isinstance(sub, ast.Continue):
+            traits["continue"] = True
+        elif (isinstance(sub, ast.Name) and handler.name
+                and sub.id == handler.name):
+            traits["refs_exc"] = True
+        elif _terminal_name(sub) in _CLASSIFIERS:
+            traits["classifier"] = True
+        elif isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Attribute) and f.attr in _LOG_METHODS:
+                traits["log"] = True
+            name = _terminal_name(f)
+            if name and "classify" in name.lower():
+                traits["classifier"] = True
+    return traits
+
+
+def _only_pass(handler) -> bool:
+    return all(isinstance(s, ast.Pass) for s in handler.body)
+
+
+def check(model):
+    for mod in model.modules:
+        if not _in_scope(mod):
+            continue
+        for fi in mod.functions:
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    if not _is_broad(handler):
+                        continue
+                    t = _body_traits(handler)
+                    line, col = handler.lineno, handler.col_offset
+                    if handler.type is None and not t["raise"]:
+                        yield Finding(
+                            RULE, mod.relpath, line, col,
+                            f"{fi.qualname}: bare `except:` swallows "
+                            "SystemExit/KeyboardInterrupt — catch "
+                            "Exception (or a classified tuple) or "
+                            "re-raise",
+                        )
+                        continue
+                    if _only_pass(handler) and fi.hot:
+                        yield Finding(
+                            RULE, mod.relpath, line, col,
+                            f"{fi.qualname}: broad swallow-and-pass on a "
+                            "hot-path function — under load this "
+                            "silently converts failures into wrong "
+                            "answers; classify into TRANSPORT_ERRORS/"
+                            "ServerException or let it propagate",
+                        )
+                        continue
+                    if t["continue"] and not t["classifier"]:
+                        yield Finding(
+                            RULE, mod.relpath, line, col,
+                            f"{fi.qualname}: broad except retries "
+                            "(`continue`) on ANY failure — a "
+                            "deterministic application error becomes an "
+                            "infinite loop; gate the handler on "
+                            "RETRYABLE_ERRORS/TRANSPORT_ERRORS",
+                        )
+                        continue
+                    if not (t["raise"] or t["log"] or t["refs_exc"]
+                            or t["classifier"]):
+                        yield Finding(
+                            RULE, mod.relpath, line, col,
+                            f"{fi.qualname}: broad except swallows the "
+                            "exception without re-raising, classifying "
+                            "(TRANSPORT_ERRORS/ServerException), "
+                            "recording, or logging it — the retry/"
+                            "failover machinery dispatches on exception "
+                            "class and this erases the signal",
+                        )

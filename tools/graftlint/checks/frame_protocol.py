@@ -319,15 +319,15 @@ def _check_call_arity(mod, server, kinds, client_cls):
 
 def _check_pins(model):
     """Every PINS entry must resolve against the linted classes; only
-    meaningful when the real package is in the model (fixture lints
-    skip)."""
-    has_engine = any(m.relpath.endswith("engine.py")
-                     and "fixtures" not in m.relpath for m in model.modules)
-    has_rpc = any(m.relpath.endswith("parallel/rpc.py") for m in model.modules)
-    if not (has_engine and has_rpc):
-        return
-
+    meaningful when every pinned class's home module is in the model
+    (fixture lints and `--changed` subsets skip — an absent class in a
+    partial lint is not a stale pin)."""
     from tools.graftlint.checks import locks as locks_mod
+
+    present = {m.relpath for m in model.modules if "fixtures" not in m.relpath}
+    for home in locks_mod.PIN_HOMES:
+        if not any(rel.endswith(home) for rel in present):
+            return
 
     pins_path = os.path.relpath(locks_mod.__file__).replace(os.sep, "/")
     try:

@@ -1,0 +1,268 @@
+"""env-knob-drift: every DFT_* knob is schema'd, documented, and agrees
+on its default.
+
+The deployment surface is a growing family of ``DFT_*`` environment
+knobs. Two conventions keep them governable: reads resolve through an
+``_EnvCfg`` schema (utils/config.py) or the ``utils/envutil.py`` helpers
+(one boolean-coercion convention, one place to grep), and every knob has
+a row in the canonical reference table in ``docs/OPERATIONS.md``
+(between ``<!-- graftlint:knob-table:start/end -->`` markers). This
+cross-artifact checker proves both directions:
+
+- **ad-hoc reads** — a raw ``os.environ``/``os.getenv`` read of a
+  ``DFT_*`` name anywhere but utils/config.py or utils/envutil.py is a
+  finding: register the knob in an ``_EnvCfg`` schema or read it through
+  ``envutil.env_flag/env_int/env_float/env_str``;
+- **undocumented code knobs** — a knob registered in a schema tuple
+  ``(type, "DFT_X", default)`` or an envutil helper call must appear in
+  the doc table;
+- **stale doc knobs** — a table row whose knob no code reads anymore is
+  operator-facing fiction and is flagged at its line in the doc;
+- **default drift** — where both sides are parseable (a literal code
+  default, a simple token in the table's Default column), they must
+  agree; booleans normalize across 1/true/on, floats numerically,
+  None across unset/none. Computed defaults (``min(8, cpus)``) and
+  prose cells are skipped by design.
+
+The doc-facing rules run only when the linted set contains a
+``utils/config.py`` (the schema home), so single-file ``--changed``
+lints stay fast and fixture lints stay self-contained: the doc is
+resolved relative to the package root (``<pkg>/../docs/OPERATIONS.md``,
+falling back to ``docs/OPERATIONS.md``).
+"""
+
+import ast
+import os
+import re
+
+from tools.graftlint.core import Finding, dotted
+
+RULE = "env-knob-drift"
+
+_KNOB_RE = re.compile(r"^DFT_[A-Z0-9_]+$")
+_ENVUTIL_HELPERS = frozenset({"env_flag", "env_int", "env_float", "env_str"})
+_TABLE_START = "graftlint:knob-table:start"
+_TABLE_END = "graftlint:knob-table:end"
+
+_SANCTIONED_SUFFIXES = ("utils/config.py", "utils/envutil.py")
+
+
+def _knob_literal(node):
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and _KNOB_RE.match(node.value)):
+        return node.value
+    return None
+
+
+def _raw_env_reads(mod):
+    """(knob, line, col) for raw os.environ / os.getenv reads of DFT_*."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d in ("os.environ.get", "os.getenv", "environ.get") and node.args:
+                knob = _knob_literal(node.args[0])
+                if knob:
+                    yield knob, node.lineno, node.col_offset
+        elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load):
+            if dotted(node.value) in ("os.environ", "environ"):
+                knob = _knob_literal(node.slice)
+                if knob:
+                    yield knob, node.lineno, node.col_offset
+
+
+def _schema_knobs(mod):
+    """(knob, default ast node, line) from ``(type, "DFT_X", default)``
+    schema tuples anywhere in a config module."""
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Tuple) and len(node.elts) == 3):
+            continue
+        knob = _knob_literal(node.elts[1])
+        if knob:
+            yield knob, node.elts[2], node.lineno
+
+
+_ABSENT = object()  # no default arg at the call site: the fallback is
+# computed by the caller, so default-drift comparison is skipped
+
+
+def _envutil_knobs(mod):
+    """(knob, default ast node or _ABSENT, line) from envutil calls."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name not in _ENVUTIL_HELPERS or not node.args:
+            continue
+        knob = _knob_literal(node.args[0])
+        if not knob:
+            continue
+        default = node.args[1] if len(node.args) > 1 else _ABSENT
+        for kw in node.keywords:
+            if kw.arg == "default":
+                default = kw.value
+        yield knob, default, node.lineno
+
+
+def _norm_default(text):
+    """Normalize a default spelling to a comparable token, or None when
+    it is prose/computed (skipped by design)."""
+    t = text.strip().strip("`").strip("'\"").strip()
+    if " " in t or "(" in t:
+        return None
+    low = t.lower()
+    if low in ("1", "true", "on", "yes"):
+        return "true"
+    if low in ("0", "false", "off", "no"):
+        return "false"
+    if low in ("", "unset", "none", "-"):
+        return "none"
+    try:
+        return repr(float(low))
+    except ValueError:
+        return low
+
+
+def _norm_code_default(node):
+    if node is _ABSENT:
+        return None  # caller-computed fallback: unparseable by design
+    if node is None:
+        return "none"
+    if not isinstance(node, ast.Constant):
+        return None  # computed default: unparseable by design
+    v = node.value
+    if v is None:
+        return "none"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(float(v))
+    if isinstance(v, str):
+        return _norm_default(v)
+    return None
+
+
+def _defaults_agree(a, b) -> bool:
+    """Token equality with the 0/1-vs-false/true ambiguity collapsed:
+    a bool knob documented as `1` and an int knob documented as `1`
+    normalize differently, but mean the same thing."""
+    if a == b:
+        return True
+    for group in ({"true", "1.0"}, {"false", "0.0"}):
+        if a in group and b in group:
+            return True
+    return False
+
+
+def _parse_doc_table(doc_path):
+    """{knob: (default cell text, line)} from the marked table."""
+    with open(doc_path, "r", encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    rows = {}
+    inside = False
+    for i, text in enumerate(lines, 1):
+        if _TABLE_START in text:
+            inside = True
+            continue
+        if _TABLE_END in text:
+            break
+        if not inside or not text.lstrip().startswith("|"):
+            continue
+        cells = [c.strip() for c in text.strip().strip("|").split("|")]
+        if len(cells) < 2:
+            continue
+        knob = cells[0].strip("`").strip()
+        if _KNOB_RE.match(knob):
+            rows[knob] = (cells[1], i)
+    return rows
+
+
+def _find_doc(config_mod):
+    """The OPERATIONS.md beside the linted package: try the package-local
+    docs/ dir first (fixtures), then the repo-root one."""
+    pkg_dir = os.path.dirname(os.path.dirname(config_mod.relpath))
+    candidates = [
+        os.path.join(pkg_dir, "docs", "OPERATIONS.md"),
+        os.path.join(os.path.dirname(pkg_dir), "docs", "OPERATIONS.md"),
+        os.path.join("docs", "OPERATIONS.md"),
+    ]
+    for c in candidates:
+        if c and os.path.isfile(c):
+            return c
+    return None
+
+
+def check(model):
+    config_mod = None
+    registered = {}   # knob -> (relpath, line, default node or "skip")
+    read_anywhere = set()
+
+    for mod in model.modules:
+        sanctioned = mod.relpath.endswith(_SANCTIONED_SUFFIXES)
+        if mod.relpath.endswith("utils/config.py"):
+            config_mod = mod
+            for knob, default, line in _schema_knobs(mod):
+                registered.setdefault(knob, (mod.relpath, line, default))
+                read_anywhere.add(knob)
+        for knob, line, col in _raw_env_reads(mod):
+            read_anywhere.add(knob)
+            if not sanctioned:
+                yield Finding(
+                    RULE, mod.relpath, line, col,
+                    f"ad-hoc environment read of {knob} — register it in "
+                    "an _EnvCfg schema (utils/config.py) or read it "
+                    "through utils/envutil.py so coercion and the knob "
+                    "inventory cannot drift",
+                )
+        for knob, default, line in _envutil_knobs(mod):
+            read_anywhere.add(knob)
+            registered.setdefault(knob, (mod.relpath, line, default))
+
+    if config_mod is None or model.subset:
+        return  # per-module ad-hoc findings above are still exact; the
+        # doc cross-check needs the full package — a subset lint cannot
+        # tell a stale doc row from a knob whose reader just wasn't in
+        # the changed set
+
+    doc_path = _find_doc(config_mod)
+    if doc_path is None:
+        yield Finding(
+            RULE, config_mod.relpath, 1, 0,
+            "no docs/OPERATIONS.md knob table found for this package — "
+            "the DFT_* knob inventory must be documented (markers "
+            f"<!-- {_TABLE_START} --> / <!-- {_TABLE_END} -->)",
+        )
+        return
+    doc_rows = _parse_doc_table(doc_path)
+    doc_rel = doc_path.replace(os.sep, "/")
+
+    for knob in sorted(registered):
+        relpath, line, default = registered[knob]
+        if knob not in doc_rows:
+            yield Finding(
+                RULE, relpath, line, 0,
+                f"knob {knob} is read by the code but has no row in the "
+                f"{doc_rel} knob table — undocumented deployment surface",
+            )
+            continue
+        code_norm = _norm_code_default(default)
+        doc_norm = _norm_default(doc_rows[knob][0])
+        if code_norm is not None and doc_norm is not None \
+                and not _defaults_agree(code_norm, doc_norm):
+            yield Finding(
+                RULE, doc_rel, doc_rows[knob][1], 0,
+                f"knob {knob}: documented default "
+                f"{doc_rows[knob][0]!r} disagrees with the code default "
+                f"({relpath}:{line}) — operators will tune against "
+                "fiction",
+            )
+
+    for knob in sorted(doc_rows):
+        if knob not in read_anywhere:
+            yield Finding(
+                RULE, doc_rel, doc_rows[knob][1], 0,
+                f"knob {knob} is documented but nothing reads it — stale "
+                "doc row (or the knob lost its schema registration)",
+            )

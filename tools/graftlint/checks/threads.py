@@ -1,0 +1,328 @@
+"""thread-lifecycle: every thread is named, daemon-explicit, tracked, and
+join-reachable from a lifecycle method.
+
+Three thread-heavy subsystems (scheduler, replication, anti-entropy /
+compaction) rest on a hand-maintained convention: a thread you cannot
+name in a stack dump, cannot find in a tracked attribute, or cannot join
+from ``stop()``/``close()``/``retire()`` is a thread that leaks past
+shutdown — exactly the failure the DFT_THREADCHECK=1 runtime witness
+(utils/threadcheck.py) catches per test, and this checker proves the
+preconditions for statically. For every ``threading.Thread(...)``
+creation site:
+
+- **named** — a ``name=`` keyword is required ("Thread-7" in a deadlocked
+  stack dump attributes to nothing);
+- **daemon-explicit** — a ``daemon=`` keyword is required: daemonness is
+  the lifecycle contract (daemon = event/connection-bound lifetime,
+  non-daemon = join-bound), so it must be a reviewed decision, not an
+  inherited default;
+- **tracked** — the Thread object must be registered somewhere an owner
+  can reach: assigned to a ``self.`` attribute, appended/added to a
+  container, returned, or handed to another call. A chained
+  ``threading.Thread(...).start()`` (or a started local nobody stores)
+  is an orphan;
+- **join-reachable** — a thread tracked in ``self.<attr>`` must have a
+  ``.join(...)`` on that attribute reachable from one of the class's
+  lifecycle methods (``stop``/``close``/``retire``/``shutdown``/
+  ``join``/``__exit__``/``__del__``), walking call edges the
+  precision-first way (``self.method()`` dispatch, same-module bare
+  names — the lock-order resolver), so a join hidden in a helper still
+  counts and a join nothing can reach does not. Snapshot-then-join
+  patterns (``t = self._thread; t.join(...)``, ``for t in
+  self._threads: t.join(...)``, ``ts = list(self._threads)``) resolve
+  through one level of local aliasing.
+
+``_thread.start_new_thread`` is always a finding: the raw spawn is
+invisible to shutdown, to stack-dump naming, and to the runtime witness.
+
+Deliberate fire-and-forget sites (per-connection reader threads whose
+lifetime IS the connection's) carry
+``# graftlint: ok(thread-lifecycle): <reason>``.
+"""
+
+import ast
+from collections import defaultdict
+
+from tools.graftlint.core import Finding, dotted
+
+RULE = "thread-lifecycle"
+
+# lifecycle methods a join path must be reachable from
+LIFECYCLE = frozenset({
+    "stop", "close", "retire", "shutdown", "join", "__exit__", "__del__",
+})
+
+_TRACK_METHODS = frozenset({"append", "add", "insert"})
+
+
+def _is_thread_ctor(call: ast.Call, mod) -> bool:
+    d = dotted(call.func)
+    if d == "threading.Thread":
+        return True
+    if isinstance(call.func, ast.Name):
+        return mod.import_aliases.get(call.func.id) == "threading.Thread"
+    return False
+
+
+def _kwarg(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw
+    return None
+
+
+def _parent_map(root):
+    parents = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _self_attr_of(node):
+    """'attr' for ``self.attr`` expressions, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _self_attrs_in(expr):
+    """Every self.<attr> name appearing anywhere under ``expr``."""
+    out = set()
+    for sub in ast.walk(expr):
+        a = _self_attr_of(sub)
+        if a:
+            out.add(a)
+    return out
+
+
+def _tracking_of(ctor, parents, fi):
+    """How a Thread ctor's value is retained, as ``(kind, attr)``:
+
+    - ("attr", X)      — lands in ``self.X`` (directly or via a local)
+    - ("container", X) — appended/added to ``self.X`` (or a local)
+    - ("escapes", None)— returned / passed to another call: tracked by
+                         the receiver, join checked there (if at all)
+    - (None, None)     — started and dropped: an orphan
+    """
+    p = parents.get(ctor)
+    # chained `threading.Thread(...).start()`
+    if isinstance(p, ast.Attribute) and isinstance(parents.get(p), ast.Call):
+        return (None, None)
+    if isinstance(p, ast.Assign):
+        for t in p.targets:
+            attr = _self_attr_of(t)
+            if attr:
+                return ("attr", attr)
+        # local: scan the whole enclosing function for where it goes
+        local = next((t.id for t in p.targets if isinstance(t, ast.Name)),
+                     None)
+        if local is None:
+            return ("escapes", None)
+        for sub in ast.walk(fi.node):
+            if isinstance(sub, ast.Assign):
+                if any(isinstance(v, ast.Name) and v.id == local
+                       for v in ast.walk(sub.value)):
+                    for t in sub.targets:
+                        attr = _self_attr_of(t)
+                        if attr:
+                            return ("attr", attr)
+            if isinstance(sub, ast.Call):
+                uses_local = any(
+                    isinstance(a, ast.Name) and a.id == local
+                    for a in list(sub.args)
+                    + [kw.value for kw in sub.keywords])
+                if not uses_local:
+                    continue
+                f = sub.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in _TRACK_METHODS):
+                    attr = _self_attr_of(f.value)
+                    return ("container", attr)  # attr may be None (local)
+                if not (isinstance(f, ast.Attribute)
+                        and f.attr == "start"):
+                    return ("escapes", None)
+            if (isinstance(sub, ast.Return) and sub.value is not None
+                    and any(isinstance(v, ast.Name) and v.id == local
+                            for v in ast.walk(sub.value))):
+                return ("escapes", None)
+        return (None, None)
+    if isinstance(p, ast.Call) and ctor in p.args:
+        return ("escapes", None)
+    if isinstance(p, ast.keyword):
+        return ("escapes", None)
+    if isinstance(p, ast.Return):
+        return ("escapes", None)
+    return (None, None)
+
+
+# ----------------------------------------------------- join reachability
+
+def _class_methods(model):
+    """(id(module), class name) -> {method name: FunctionInfo}."""
+    out = defaultdict(dict)
+    for fi in model.functions:
+        if fi.cls is not None:
+            out[(id(fi.module), fi.cls)][fi.name] = fi
+    return out
+
+
+def _joined_attrs(methods):
+    """Self attributes with ``.join(...)`` evidence in methods reachable
+    from a lifecycle method via ``self.method()`` / same-class bare-name
+    edges — the taint propagation that attributes a join in a helper to
+    the lifecycle path that reaches it."""
+    # reachability over the class's own methods
+    reachable = {n for n in methods if n in LIFECYCLE}
+    frontier = list(reachable)
+    while frontier:
+        m = methods[frontier.pop()]
+        for sub in ast.walk(m.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            callee = None
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self" and f.attr in methods):
+                callee = f.attr
+            elif isinstance(f, ast.Name) and f.id in methods:
+                callee = f.id
+            if callee is not None and callee not in reachable:
+                reachable.add(callee)
+                frontier.append(callee)
+
+    joined = set()
+    for name in reachable:
+        node = methods[name].node
+        # one level of local aliasing: v = self.X / ts = list(self.X) /
+        # for v in self.X — each maps the local to the attrs it came
+        # from. Iterated to a fixpoint: ast.walk is breadth-first, so a
+        # snapshot assignment nested in a `with` block is visited AFTER
+        # the top-level for-loop that consumes it
+        alias = defaultdict(set)
+        for _ in range(3):
+            grew = False
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    # element-wise tuple unpack (`ts, self.X = self.X, []`
+                    # — the snapshot-and-swap drain idiom) before the
+                    # whole-RHS fallback
+                    pairs = []
+                    for t in sub.targets:
+                        if (isinstance(t, ast.Tuple)
+                                and isinstance(sub.value, ast.Tuple)
+                                and len(t.elts) == len(sub.value.elts)):
+                            pairs += list(zip(t.elts, sub.value.elts))
+                        else:
+                            pairs.append((t, sub.value))
+                    for tgt, val in pairs:
+                        if not isinstance(tgt, ast.Name):
+                            continue
+                        attrs = set(_self_attrs_in(val))
+                        for n in ast.walk(val):
+                            if (isinstance(n, ast.Name)
+                                    and isinstance(n.ctx, ast.Load)):
+                                attrs |= alias.get(n.id, set())
+                        if not attrs <= alias[tgt.id]:
+                            alias[tgt.id] |= attrs
+                            grew = True
+                elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                    srcs = set(_self_attrs_in(sub.iter))
+                    for n in ast.walk(sub.iter):
+                        if (isinstance(n, ast.Name)
+                                and isinstance(n.ctx, ast.Load)):
+                            srcs |= alias.get(n.id, set())
+                    if (isinstance(sub.target, ast.Name)
+                            and not srcs <= alias[sub.target.id]):
+                        alias[sub.target.id] |= srcs
+                        grew = True
+            if not grew:
+                break
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "join"):
+                continue
+            base = sub.func.value
+            attr = _self_attr_of(base)
+            if attr:
+                joined.add(attr)
+            elif isinstance(base, ast.Name):
+                joined |= alias.get(base.id, set())
+    return joined
+
+
+def check(model):
+    methods_by_cls = _class_methods(model)
+    joined_cache = {}
+
+    def joined_attrs_for(key):
+        if key not in joined_cache:
+            joined_cache[key] = _joined_attrs(methods_by_cls.get(key, {}))
+        return joined_cache[key]
+
+    for fi in model.functions:
+        mod = fi.module
+        parents = None
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted(node.func) == "_thread.start_new_thread":
+                yield Finding(
+                    RULE, mod.relpath, node.lineno, node.col_offset,
+                    f"{fi.qualname} spawns via _thread.start_new_thread — "
+                    "unnamed, untracked, invisible to shutdown and the "
+                    "DFT_THREADCHECK witness; use a named, tracked "
+                    "threading.Thread",
+                )
+                continue
+            if not _is_thread_ctor(node, mod):
+                continue
+            where = f"{fi.qualname} creates a thread"
+            if _kwarg(node, "name") is None:
+                yield Finding(
+                    RULE, mod.relpath, node.lineno, node.col_offset,
+                    f"{where} without name= — an anonymous 'Thread-N' in "
+                    "a stack dump or leak report attributes to nothing",
+                )
+            if _kwarg(node, "daemon") is None:
+                yield Finding(
+                    RULE, mod.relpath, node.lineno, node.col_offset,
+                    f"{where} without an explicit daemon= — daemonness is "
+                    "the lifecycle contract (daemon: event/connection-"
+                    "bound; non-daemon: join-bound) and must be a "
+                    "reviewed decision",
+                )
+            if parents is None:
+                parents = _parent_map(fi.node)
+            kind, attr = _tracking_of(node, parents, fi)
+            if kind is None:
+                yield Finding(
+                    RULE, mod.relpath, node.lineno, node.col_offset,
+                    f"{where} that is started but never registered in a "
+                    "tracked container (self attribute, list, or caller) "
+                    "— an orphan no stop()/close()/retire() can reach",
+                )
+                continue
+            if attr is None:
+                continue  # escapes / local container: join checked elsewhere
+            if fi.cls is not None:
+                keys = [(id(mod), fi.cls)]
+            else:
+                # helper spawn outside a class (module function storing
+                # into a parameter's attribute): attribute the join
+                # requirement to every linted class carrying that attr
+                keys = [k for k, ms in methods_by_cls.items()
+                        if any(attr in _self_attrs_in(m.node)
+                               for m in ms.values())]
+            if any(attr in joined_attrs_for(k) for k in keys):
+                continue
+            yield Finding(
+                RULE, mod.relpath, node.lineno, node.col_offset,
+                f"{where} tracked in `self.{attr}` with no .join() on it "
+                "reachable from a lifecycle method "
+                "(stop/close/retire/shutdown/join/__exit__/__del__) — "
+                "tracked but unjoinable is still a leak",
+            )

@@ -26,8 +26,10 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import os
 import re
+import tokenize
 from collections import defaultdict
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
@@ -332,7 +334,7 @@ class ModuleInfo:
         self.tree = ast.parse(source, filename=path)
         self.suppressions: Dict[int, Set[str]] = {}
         self.hot_lines: Set[int] = set()
-        for i, text in enumerate(self.lines, 1):
+        for i, text in self._comment_lines():
             m = SUPPRESS_RE.search(text)
             if m:
                 self.suppressions[i] = {
@@ -397,14 +399,35 @@ class ModuleInfo:
 
     # -- suppression / classification helpers ----------------------------
 
-    def suppressed(self, rule: str, line: int) -> bool:
-        """A finding is suppressed by ``# graftlint: ok(<rule>)`` on its own
-        line, the line above, or on/above the ``def`` line of an enclosing
-        function (which scopes the suppression to the whole function)."""
+    def _comment_lines(self):
+        """(line, text) for every line carrying a real ``#`` COMMENT token.
+        Annotations live in comments; scanning raw source lines would also
+        match docstring/string-literal mentions of the syntax (e.g. the
+        examples in this package's own docstrings), which must neither
+        create suppressions nor trip the suppression-rot audit. Falls
+        back to the raw line scan only when the module fails to tokenize
+        (it already parsed, so this is near-unreachable)."""
+        try:
+            out = []
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    out.append((tok.start[0], tok.string))
+            return out
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            return [(i, t) for i, t in enumerate(self.lines, 1) if "#" in t]
+
+    def match_suppression(self, rule: str, line: int) -> Optional[int]:
+        """Comment line of the ``# graftlint: ok(<rule>)`` that covers a
+        finding at ``line`` — its own line, the line above, or on/above
+        the ``def`` line of an enclosing function (which scopes the
+        suppression to the whole function). None when unsuppressed. The
+        returned line is how ``lint`` records which suppressions earned
+        their keep (the suppression-rot audit flags the rest)."""
         for ln in (line, line - 1):
             rules = self.suppressions.get(ln)
             if rules and (rule in rules or "all" in rules):
-                return True
+                return ln
         for u in self.units:
             end = getattr(u.node, "end_lineno", u.lineno)
             if not (u.lineno <= line <= end):
@@ -412,8 +435,11 @@ class ModuleInfo:
             for ln in (u.lineno, u.lineno - 1):
                 rules = self.suppressions.get(ln)
                 if rules and (rule in rules or "all" in rules):
-                    return True
-        return False
+                    return ln
+        return None
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return self.match_suppression(rule, line) is not None
 
     def internal_alias(self, name: str) -> bool:
         """True when ``name`` is an import alias of a module in this repo
@@ -429,7 +455,11 @@ class ModuleInfo:
 
 
 class RepoModel:
-    def __init__(self, modules: List[ModuleInfo]):
+    def __init__(self, modules: List[ModuleInfo], subset: bool = False):
+        # subset=True: a partial lint (`--changed`) — cross-artifact rules
+        # that are only decidable against the full package (knob/doc
+        # drift, the suppression-rot audit) must gate themselves off
+        self.subset = subset
         self.modules = modules
         self.functions: List[FunctionInfo] = [
             f for m in modules for f in m.functions
@@ -482,13 +512,72 @@ def collect_files(paths: Iterable[str]) -> List[str]:
     return out
 
 
-def build_model(paths: Iterable[str]) -> RepoModel:
+def build_model(paths: Iterable[str], subset: bool = False) -> RepoModel:
     modules = []
     for path in collect_files(paths):
         with open(path, "r", encoding="utf-8") as f:
             source = f.read()
         modules.append(ModuleInfo(path, os.path.relpath(path), source))
-    return RepoModel(modules)
+    return RepoModel(modules, subset=subset)
+
+
+SUPPRESSION_AUDIT_RULE = "unused-suppression"
+
+
+def _audit_suppressions(model: RepoModel, used: Dict[int, Set[int]],
+                        known_rules: Set[str]) -> List[Finding]:
+    """The suppression-rot audit: every ``# graftlint: ok(<rule>)`` comment
+    must either suppress a live finding THIS run or name a rule that no
+    longer exists — a suppression that does neither is itself a finding,
+    so the reviewed-waiver inventory can't rot into a pile of comments
+    nobody can tell apart from load-bearing ones. Deliberately-dormant
+    waivers (e.g. version-gated code paths) opt out explicitly with
+    ``ok(unused-suppression)`` beside them — which that very audit then
+    tracks like any other suppression."""
+    out: List[Finding] = []
+    for mod in model.modules:
+        used_lines = used.get(id(mod), set())
+        markers = []  # pure ok(unused-suppression) lines, audited last
+        for line in sorted(mod.suppressions):
+            if line in used_lines:
+                continue
+            rules = mod.suppressions[line]
+            if SUPPRESSION_AUDIT_RULE in rules:
+                # an opt-out marker is "used" exactly when it waives a
+                # dormant neighbor (recorded below). A PURE marker that
+                # ends up waiving nothing is itself rot and is audited
+                # after all neighbors have been processed; a combined
+                # line (ok(<rule>, unused-suppression)) self-waives.
+                if rules == {SUPPRESSION_AUDIT_RULE}:
+                    markers.append(line)
+                continue
+            unknown = sorted(
+                r for r in rules
+                if r not in known_rules and r != "all")
+            waiver = mod.match_suppression(SUPPRESSION_AUDIT_RULE, line)
+            if waiver is not None:
+                used_lines.add(waiver)
+                continue
+            if unknown:
+                msg = (f"suppression names unknown rule(s) "
+                       f"{', '.join(unknown)} — a typo'd ok() suppresses "
+                       "nothing; fix the rule name or delete the comment")
+            else:
+                msg = (f"stale suppression: ok({', '.join(sorted(rules))}) "
+                       "no longer suppresses any finding — delete it, or "
+                       "waive deliberately-dormant waivers with "
+                       "ok(unused-suppression)")
+            out.append(Finding(SUPPRESSION_AUDIT_RULE, mod.relpath,
+                               line, 0, msg))
+        for line in markers:
+            if line in used_lines:
+                continue
+            out.append(Finding(
+                SUPPRESSION_AUDIT_RULE, mod.relpath, line, 0,
+                "orphaned ok(unused-suppression): it waives no dormant "
+                "suppression beside it — the waiver it covered was "
+                "deleted; delete this marker too"))
+    return out
 
 
 def lint(model: RepoModel) -> List[Finding]:
@@ -496,15 +585,31 @@ def lint(model: RepoModel) -> List[Finding]:
 
     findings: List[Finding] = []
     by_path = {m.relpath: m for m in model.modules}
+    used: Dict[int, Set[int]] = defaultdict(set)  # id(mod) -> comment lines
     for checker in checks.ALL:
         for f in checker.check(model):
             mod = by_path.get(f.path)
-            if mod is not None and mod.suppressed(f.rule, f.line):
-                continue
+            if mod is not None:
+                sline = mod.match_suppression(f.rule, f.line)
+                if sline is not None:
+                    used[id(mod)].add(sline)
+                    continue
             findings.append(f)
+    if not model.subset:
+        # the rot audit is only decidable against the full package: a
+        # suppression whose finding resolves through modules OUTSIDE the
+        # linted subset (a locked device launch into an unlinted jitted
+        # callee, say) would look stale on every partial lint
+        known = set(checks.RULES) | {SUPPRESSION_AUDIT_RULE}
+        findings += _audit_suppressions(model, used, known)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
-def lint_paths(paths: Iterable[str]) -> List[Finding]:
-    return lint(build_model(paths))
+def lint_paths(paths: Iterable[str], subset: bool = False) -> List[Finding]:
+    """Lint ``paths``. ``subset=True`` marks a partial lint (the
+    ``--changed`` precommit fast path): cross-artifact rules that are
+    only decidable against the full package — the suppression-rot audit
+    and env-knob-drift's doc cross-check — gate themselves off; CI's
+    full lint keeps them on."""
+    return lint(build_model(paths, subset=subset))
