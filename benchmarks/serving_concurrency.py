@@ -481,6 +481,159 @@ def run_churn_arm(n_threads=8, batch=32, reps=8, k=10):
     return rows
 
 
+def run_convergence_arm(reps=1):
+    """Versioning A/B (ISSUE 12): time an R=2 group's server-side
+    anti-entropy convergence to IDENTICAL wire digests after a one-sided
+    mutation burst (deletes + upserts applied to one replica only — the
+    outage shape), with per-id versions on vs off.
+
+    Each arm reports ``convergence_s`` (burst -> byte-identical digests
+    over the wire) and ``upserts_replicated``: whether the peer replica
+    ends up serving the upserted VECTORS. With versioning on the sweep
+    refresh-pulls them (rows_refreshed); with versioning off the id-only
+    digest cannot see an in-place upsert, so the digests converge while
+    the content silently doesn't — the exact blind spot the versioned
+    plane exists to close (the row records it honestly)."""
+    import socket as socketlib
+    import tempfile
+    import threading
+
+    from distributed_faiss_tpu.mutation.versions import HLC
+    from distributed_faiss_tpu.parallel import antientropy, rpc
+    from distributed_faiss_tpu.parallel.client import IndexClient
+    from distributed_faiss_tpu.parallel.server import IndexServer
+    from distributed_faiss_tpu.utils.config import (
+        AntiEntropyCfg,
+        IndexCfg,
+        ReplicationCfg,
+        VersioningCfg,
+    )
+    from distributed_faiss_tpu.utils.state import IndexState
+    import jax
+
+    backend = jax.devices()[0].platform
+    small = os.environ.get("BENCH_SMALL") == "1"
+    n, d, burst = (4_000 if small else 20_000), 64, 64
+
+    def free_port():
+        s = socketlib.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def wire_digest(port, index_id):
+        resp = rpc.digest_exchange(
+            "localhost", port, {"rank": None, "group": None, "want": None},
+            timeout=5.0)
+        return resp["digests"].get(index_id)
+
+    rows = []
+    for versioned in (True, False):
+        tmp = tempfile.mkdtemp(prefix="dft-vconv-")
+        pa, pb = free_port(), free_port()
+        disc = os.path.join(tmp, "disc.txt")
+        with open(disc, "w") as f:
+            f.write(f"2\nlocalhost,{pa}\nlocalhost,{pb}\n")
+        ae = AntiEntropyCfg(interval_s=0.25)
+        a = IndexServer(0, os.path.join(tmp, "a"), discovery_path=disc,
+                        antientropy_cfg=ae)
+        b = IndexServer(1, os.path.join(tmp, "b"), discovery_path=disc,
+                        antientropy_cfg=ae)
+        threading.Thread(target=a.start_blocking, args=(pa,),
+                         daemon=True).start()
+        threading.Thread(target=b.start_blocking, args=(pb,),
+                         daemon=True).start()
+        time.sleep(0.5)
+        client = IndexClient(
+            disc,
+            replication_cfg=ReplicationCfg(replication=2, write_quorum=1),
+            versioning_cfg=VersioningCfg(enabled=versioned))
+        try:
+            cfg = IndexCfg(index_builder_type="flat", dim=d, metric="l2",
+                           train_num=min(n, 2048))
+            client.create_index("conv", cfg)
+            rng = np.random.default_rng(7)
+            x = rng.standard_normal((n, d)).astype(np.float32)
+            step = max(n // 4, 1)
+            for s in range(0, n, step):
+                client.add_index_data(
+                    "conv", x[s:s + step],
+                    [(i,) for i in range(s, min(s + step, n))])
+            deadline = time.time() + 600
+            while not (client.get_state("conv") == IndexState.TRAINED
+                       and client.get_buffer_depth("conv") == 0):
+                assert time.time() < deadline, "ingest never drained"
+                time.sleep(0.1)
+            deadline = time.time() + 120
+            while wire_digest(pa, "conv") != wire_digest(pb, "conv"):
+                assert time.time() < deadline, "never converged pre-burst"
+                time.sleep(0.2)
+
+            # one-sided burst on rank A only (the outage shape): deletes
+            # + upserts the peer never saw
+            clock = HLC(writer_id=99)
+            eng = a._get_index("conv")
+            dead_ids = list(range(0, burst))
+            up_ids = list(range(burst, 2 * burst))
+            new_vecs = (x[up_ids] + 0.5).astype(np.float32)
+            eng.remove_ids(dead_ids,
+                           version=clock.tick() if versioned else None)
+            eng.upsert(up_ids, new_vecs, [(i,) for i in up_ids],
+                       version=clock.tick() if versioned else None)
+            while a.get_aggregated_ntotal("conv") > 0:
+                time.sleep(0.05)
+            t0 = time.perf_counter()
+            deadline = time.time() + 300
+            while True:
+                da, db = wire_digest(pa, "conv"), wire_digest(pb, "conv")
+                if da is not None and da == db:
+                    break
+                assert time.time() < deadline, "burst never converged"
+                time.sleep(0.1)
+            dt = time.perf_counter() - t0
+            while b.get_aggregated_ntotal("conv") > 0:
+                time.sleep(0.05)
+            # did the upserted CONTENT replicate to the peer? exact-match
+            # distance, not nearest-id: the stale row is still the
+            # nearest ID to its own upsert, so only a ~zero l2 distance
+            # proves the peer serves the new VECTORS
+            sc, meta, _e = b._get_index("conv").search(new_vecs[:8], 1)
+            replicated = (
+                [m[0] for m in meta] == [(i,) for i in up_ids[:8]]
+                and float(np.abs(sc).max()) < 1e-3)
+            ae_stats = b.get_perf_stats()["antientropy"]
+            rows.append({
+                "case": "churn_convergence", "backend": backend,
+                "versioning": "on" if versioned else "off",
+                "rows": n, "burst_deletes": burst, "burst_upserts": burst,
+                "convergence_s": round(dt, 2),
+                "rows_repaired": ae_stats["rows_repaired"],
+                "rows_refreshed": ae_stats.get("rows_refreshed", 0),
+                "upserts_replicated": replicated,
+            })
+        finally:
+            client.close()
+            for srv in (a, b):
+                # light teardown (run_mux_arms precedent): no full stop()
+                # saves — the process exits right after the arms
+                srv._stopping.set()
+                if srv._antientropy is not None:
+                    srv._antientropy.stop()
+                if srv.socket is not None:
+                    try:
+                        srv.socket.close()
+                    except OSError:
+                        pass
+                if srv.scheduler is not None:
+                    srv.scheduler.stop()
+    # the headline contract: versions make the sweep converge CONTENT,
+    # not just id sets
+    by_arm = {r["versioning"]: r for r in rows}
+    assert by_arm["on"]["upserts_replicated"] is True, by_arm
+    return rows
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -506,10 +659,13 @@ def main():
              "mesh (forces XLA_FLAGS before jax imports; default: none — "
              "run with --mesh both for the one-launch-per-window check)")
     parser.add_argument(
-        "--churn", choices=("on", "none"), default="none",
-        help="mutable-corpora churn arm: interleaved delete/upsert under a "
-             "live query storm, with and without an active compaction pass "
-             "(default: none)")
+        "--churn", choices=("on", "convergence", "both", "none"),
+        default="none",
+        help="mutable-corpora churn arms: 'on' = interleaved delete/upsert "
+             "under a live query storm with/without an active compaction "
+             "pass; 'convergence' = R=2 anti-entropy "
+             "convergence-to-identical-digests after a one-sided mutation "
+             "burst, per-id versioning on vs off (default: none)")
     parser.add_argument(
         "--modes", default="percall,natural,window",
         help="comma list of legacy batcher modes to run ('' = skip)")
@@ -616,9 +772,13 @@ def main():
             # mesh as exactly ONE pjit launch
             assert r["launches_per_window_max"] == 1.0, r
 
-    if args.churn != "none":
+    if args.churn in ("on", "both"):
         for row in run_churn_arm(n_threads=n_threads, batch=batch,
                                  reps=reps, k=k):
+            print(json.dumps(row), flush=True)
+
+    if args.churn in ("convergence", "both"):
+        for row in run_convergence_arm():
             print(json.dumps(row), flush=True)
 
 

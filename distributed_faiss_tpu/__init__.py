@@ -37,6 +37,8 @@ _LAZY = {
     "MeshCfg": ("distributed_faiss_tpu.utils.config", "MeshCfg"),
     "ReplicationCfg": ("distributed_faiss_tpu.utils.config", "ReplicationCfg"),
     "AntiEntropyCfg": ("distributed_faiss_tpu.utils.config", "AntiEntropyCfg"),
+    "VersioningCfg": ("distributed_faiss_tpu.utils.config", "VersioningCfg"),
+    "HLC": ("distributed_faiss_tpu.mutation.versions", "HLC"),
     "QuorumError": ("distributed_faiss_tpu.parallel.client", "QuorumError"),
     "MembershipTable": ("distributed_faiss_tpu.parallel.replication",
                         "MembershipTable"),

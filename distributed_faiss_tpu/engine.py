@@ -37,10 +37,15 @@ from distributed_faiss_tpu.models.factory import (
 )
 from distributed_faiss_tpu.mutation import compaction as _compaction
 from distributed_faiss_tpu.mutation import tombstones as _tombstones
+from distributed_faiss_tpu.mutation import versions as _versions
 from distributed_faiss_tpu.mutation.tombstones import TombstoneSet
 from distributed_faiss_tpu.utils import envutil, lockdep, serialization
 from distributed_faiss_tpu.utils.batching import SearchBatcher
-from distributed_faiss_tpu.utils.config import IndexCfg, MutationCfg
+from distributed_faiss_tpu.utils.config import (
+    IndexCfg,
+    MutationCfg,
+    VersioningCfg,
+)
 from distributed_faiss_tpu.utils.serialization import (
     atomic_write,
     load_state,
@@ -48,6 +53,7 @@ from distributed_faiss_tpu.utils.serialization import (
 )
 from distributed_faiss_tpu.utils.state import (
     NOT_TRAINED_REJECTION_FMT,
+    STALE_READ_REJECTION_FMT,
     IndexState,
 )
 from distributed_faiss_tpu.utils.tracing import LatencyStats
@@ -163,6 +169,30 @@ def _iter_live_ids(meta_arr, meta_n: int, dead_rows, id_idx: int):
         yield p, mid, m
 
 
+def _normalize_batch_versions(version, n: int):
+    """Normalize ``add_batch``'s ``version`` argument: None (unversioned),
+    ONE version stamped onto every row of the batch (a client mutation
+    call ticks once), or a per-row list (the anti-entropy delta pull,
+    whose rows come from different original writes). Returns
+    ``(vlist, per_row)``: None or a list of n normalized version keys
+    (entries may be None), and whether the caller supplied per-ROW
+    versions — which is also the replace-eligibility signal: only the
+    delta pull replaces an older live row in place (metadata ids are not
+    required to be unique, so a plain ingest batch must never treat "id
+    already live at an older version" as an upsert — shared-id corpora
+    would eat their own earlier batches)."""
+    if version is None:
+        return None, False
+    if (isinstance(version, (list, tuple)) and len(version) == 3
+            and all(isinstance(c, (int, np.integer)) for c in version)):
+        return [_versions.version_key(version)] * n, False
+    out = [_versions.version_key(v) for v in version]
+    if len(out) != n:
+        raise RuntimeError(
+            "versions length should match the batch size of the embeddings")
+    return out, True
+
+
 def _apply_sidecar_by_id(tomb: "TombstoneSet", side: dict, meta: list,
                          id_idx: int, storage_dir: str) -> None:
     """Cross-layout tombstone recovery: the standalone sidecar's POSITIONS
@@ -269,7 +299,27 @@ class Index:
         self.tombstones = TombstoneSet()
         self._mutation_counters = {
             "compactions": 0, "compactions_aborted": 0, "load_fallbacks": 0,
+            # LWW version gates (mutation/versions.py): stale replays
+            # that no-op'd instead of double-applying — the repair-queue
+            # re-send / duplicated-fan-out idempotency signal — and adds
+            # that REPLACED an older live row in place (anti-entropy
+            # upsert refresh)
+            "version_noop_adds": 0, "version_noop_deletes": 0,
+            "version_replaced": 0,
         }
+        # per-id mutation versioning (ISSUE 12): per-WRITER watermarks of
+        # the newest version this shard has incorporated (the
+        # read-your-writes gate; writer -> (wall_ms, counter)). Per-id
+        # versions live in the TombstoneSet (live map + versioned
+        # ledger), all under index_lock.
+        self.versioning = VersioningCfg.from_env()
+        self._version_watermark = {}
+        # generation-pinned point-in-time reads (search_at_generation):
+        # one cached read-only snapshot of a retained committed
+        # generation, loaded lazily. Its own leaf lock — a pinned read
+        # must never contend with the serving locks.
+        self._pinned_lock = lockdep.lock("Index._pinned_lock")
+        self._pinned_cache = None
         # standalone-sidecar writer: mutations snapshot their payload (and
         # a version) under the engine locks but perform the JSON
         # rewrite+fsync OUTSIDE them — a delete storm must not stall the
@@ -280,6 +330,11 @@ class Index:
         self._tombstone_io_lock = lockdep.lock("Index._tombstone_io_lock")
         self._tombstone_version = 0  # guarded by index_lock
         self._tombstone_written = 0  # guarded by _tombstone_io_lock
+        # tombstone version captured by the last committed generation:
+        # a delete/version-only change (ntotal unchanged) must still
+        # commit on the next save, or generation-pinned reads could
+        # never pin a post-delete point in time. Guarded by index_lock.
+        self._saved_tombstone_version = 0
         # metadata layout epoch (seqlock): bumped under BOTH locks whenever
         # the positional row layout is replaced (compaction swap,
         # drop_index), so a search that launched on the old layout retries
@@ -331,6 +386,7 @@ class Index:
         embeddings: np.ndarray,
         metadata: Optional[List[object]],
         train_async_if_triggered: bool = True,
+        version=None,
     ) -> None:
         n = embeddings.shape[0]
         if not metadata:
@@ -339,35 +395,46 @@ class Index:
             raise RuntimeError("metadata length should match the batch size of the embeddings")
         embeddings = np.asarray(embeddings, np.float32)
 
-        with self.buffer_lock:
-            self.embeddings_buffer.append(embeddings)
-            self.id_to_metadata.extend(metadata)
-            self.total_data += n
-            total_data = self.total_data
+        versions_list, per_row = _normalize_batch_versions(version, n)
+        if versions_list is not None:
+            # versioned write path (ISSUE 12): LWW-gated per id — stale
+            # replays no-op, and (per-row versions only: the delta-pull
+            # path) strictly newer versions replace older live rows in
+            # place. One atomic apply under both locks.
+            total_data = self._add_batch_versioned(
+                embeddings, metadata, versions_list,
+                allow_replace=per_row)
+        else:
+            with self.buffer_lock:
+                self.embeddings_buffer.append(embeddings)
+                self.id_to_metadata.extend(metadata)
+                self.total_data += n
+                total_data = self.total_data
 
-        # a re-added id is live again: drop its deletion-ledger entry so
-        # anti-entropy can replicate the re-add (upsert semantics). O(batch)
-        # hash lookups, and only when a delete ever happened here. The
-        # unledger must be DURABLE like the delete it reverses: a restart
-        # re-reads the sidecar, and a stale ledger entry would let a
-        # peer's delete-wins sweep re-delete the acked re-add cluster-wide
-        payload = None
-        with self.index_lock:
-            if self.tombstones.ledger_size():
-                id_idx = self.cfg.custom_meta_id_idx
-                keys = []
-                for m in metadata:
-                    if not m:
-                        continue
-                    try:
-                        keys.append(m[id_idx])
-                    except (TypeError, IndexError, KeyError):
-                        continue
-                if self.tombstones.unledger(keys):
-                    self._digest_cache = None
-                    payload, version = self._tombstone_payload_locked()
-        if payload is not None:
-            self._write_tombstone_sidecar(payload, version)
+            # a re-added id is live again: drop its deletion-ledger entry
+            # so anti-entropy can replicate the re-add (upsert semantics).
+            # O(batch) hash lookups, and only when a delete ever happened
+            # here. The unledger must be DURABLE like the delete it
+            # reverses: a restart re-reads the sidecar, and a stale ledger
+            # entry would let a peer's delete-wins sweep re-delete the
+            # acked re-add cluster-wide
+            payload = None
+            with self.index_lock:
+                if self.tombstones.ledger_size():
+                    id_idx = self.cfg.custom_meta_id_idx
+                    keys = []
+                    for m in metadata:
+                        if not m:
+                            continue
+                        try:
+                            keys.append(m[id_idx])
+                        except (TypeError, IndexError, KeyError):
+                            continue
+                    if self.tombstones.unledger(keys):
+                        self._digest_cache = None
+                        payload, sc_version = self._tombstone_payload_locked()
+            if payload is not None:
+                self._write_tombstone_sidecar(payload, sc_version)
 
         state = self.get_state()
         if state == IndexState.TRAINED:
@@ -383,11 +450,173 @@ class Index:
             else:
                 self.train()
 
+    def _add_batch_versioned(self, embeddings: np.ndarray, metadata: list,
+                             vlist: list, allow_replace: bool) -> int:
+        """LWW-gated append (mutation/versions.py): per id, a row whose
+        version loses to the current live/ledger state is a NO-OP (the
+        repair-replay / duplicated-fan-out idempotency contract);
+        with ``allow_replace`` (per-row versions — ONLY the anti-entropy
+        delta pull, whose rows are known-unique exports) a row strictly
+        newer than a versioned live occupant REPLACES it in place (the
+        old rows tombstone in the same lock hold — the upsert-refresh
+        path); everything else appends normally — in particular a plain
+        single-stamp ingest batch NEVER replaces, because metadata ids
+        are not required to be unique and an id "already live at an
+        older version" is ordinary shared-id ingest there. The whole
+        decide+apply runs under both engine locks so no concurrent
+        delete can interleave between the gate check and the append; the
+        sidecar write (ledger changes must survive a crash, or a stale
+        delete would win after restart) happens outside them as ever.
+        Returns the post-append buffered total (the training trigger)."""
+        id_idx = self.cfg.custom_meta_id_idx
+        keys = []
+        for m in metadata:
+            k = None
+            if m:
+                try:
+                    k = _id_match_key(m[id_idx])
+                except (TypeError, IndexError, KeyError):
+                    k = None
+            keys.append(k)
+
+        def scan(meta_arr, lo, hi, want):
+            found = []
+            for p in range(lo, hi):
+                m = meta_arr[p]
+                if not m:
+                    continue
+                try:
+                    mid = m[id_idx]
+                except (TypeError, IndexError, KeyError):
+                    continue
+                if _id_match_key(mid) in want:
+                    found.append((p, mid))
+            return found
+
+        # lock-free prescan (the remove_ids pattern): candidate positions
+        # for ANY batch key against the append-only metadata snapshot, so
+        # the O(rows) walk a displacement needs never runs under the
+        # serving locks (a refresh pull on a large shard must not stall
+        # searches chunk after chunk); the locked section below only
+        # rescans the tail appended since — or everything, in the rare
+        # case a compaction swapped the layout mid-flight.
+        batch_keys = {k for k in keys if k is not None}
+        candidates = []
+        if allow_replace and batch_keys:
+            with self.buffer_lock:
+                epoch0 = self._meta_epoch
+                meta_arr0, meta_n0 = self.id_to_metadata.snapshot()
+            candidates = scan(meta_arr0, 0, meta_n0, batch_keys)
+        with self.buffer_lock, self.index_lock:
+            tomb = self.tombstones
+            keep = [True] * len(metadata)
+            replace_keys = set()
+            noop = 0
+            for i, (k, v) in enumerate(zip(keys, vlist)):
+                self._observe_version_locked(v)
+                if k is None or v is None:
+                    continue
+                live_v = tomb.live_version(k)
+                if _versions.add_loses(v, live_v, tomb.ledger_version(k)):
+                    keep[i] = False
+                    noop += 1
+                elif allow_replace:
+                    # delta-pull rows displace ANY live occupant of their
+                    # id — including an UNVERSIONED one (legacy ingest,
+                    # or the crash window that drops uncommitted live
+                    # versions): appending beside it would leave two live
+                    # rows for the id and wedge digest convergence
+                    # forever. An id with no live rows just contributes
+                    # nothing to the replace scan below.
+                    replace_keys.add(k)
+            self._mutation_counters["version_noop_adds"] += noop
+            replaced_rows = 0
+            if replace_keys:
+                meta_arr, meta_n = self.id_to_metadata.snapshot()
+                indexed_n = (self.tpu_index.ntotal
+                             if self.tpu_index is not None else 0)
+                if self._meta_epoch != epoch0:
+                    # layout swapped since the lock-free prescan: the
+                    # candidate positions are stale — full rescan (rare)
+                    candidates = scan(meta_arr, 0, meta_n, batch_keys)
+                else:
+                    candidates += scan(meta_arr, meta_n0, meta_n,
+                                       batch_keys)
+                rows, rids = [], []
+                for p, mid in candidates:
+                    if p in tomb:
+                        continue
+                    if _id_match_key(mid) in replace_keys:
+                        rows.append(p)
+                        rids.append(mid)
+                if rows:
+                    # only an ACTUAL displacement needs the tombstone
+                    # mask (a pull of purely-missing rows must not hit
+                    # the unsupported-kind rejection)
+                    self._check_remove_supported_locked()
+                    device_rows = [p for p in rows if p < indexed_n]
+                    if device_rows:
+                        # graftlint: ok(blocking-under-lock): the locked mask scatter is the tombstone consistency contract — device mutations serialize on index_lock like every launch
+                        self.tpu_index.remove_rows(
+                            np.asarray(device_rows, np.int64))
+                    tomb.add(rows, rids)
+                    replaced_rows = len(rows)
+                    self._mutation_counters["version_replaced"] += replaced_rows
+            kept_n = sum(keep)
+            unledgered = 0
+            if kept_n:
+                if kept_n == len(metadata):
+                    kept_emb, kept_meta = embeddings, metadata
+                else:
+                    mask = np.asarray(keep, bool)
+                    kept_emb = embeddings[mask]
+                    kept_meta = [m for i, m in enumerate(metadata)
+                                 if keep[i]]
+                self.embeddings_buffer.append(kept_emb)
+                self.id_to_metadata.extend(kept_meta)
+                self.total_data += kept_n
+                for i, (k, v) in enumerate(zip(keys, vlist)):
+                    if not keep[i] or k is None:
+                        continue
+                    if v is not None:
+                        tomb.set_live_version(k, _versions.newest(
+                            tomb.live_version(k), v))
+                    # the landing write outranks any recorded delete (the
+                    # add gate already compared): the id is pullable again
+                    unledgered += tomb.unledger([k])
+            total_data = self.total_data
+            # sidecar durability point ONLY when the batch touched the
+            # deletion state (re-add over a ledger entry, in-place
+            # replace) — the payload is O(versioned ids), so rewriting it
+            # per plain ingest batch would make a bulk load quadratic.
+            # Plain appends' live versions become durable at the next
+            # generation commit instead; a crash inside that window
+            # degrades exactly those rows to unversioned (legacy
+            # delete-wins, replayable) and the sweep re-converges them —
+            # the pre-version exposure, bounded to the uncommitted tail.
+            payload = None
+            if replaced_rows or unledgered:
+                self._digest_cache = None
+                payload, sc_version = self._tombstone_payload_locked()
+        if payload is not None:
+            self._write_tombstone_sidecar(payload, sc_version)
+        return total_data
+
     # ---------------------------------------------------------------- mutation
 
-    def remove_ids(self, ids) -> int:
+    def remove_ids(self, ids, version=None) -> int:
         """Tombstone every row whose metadata id (``cfg.custom_meta_id_idx``)
         is in ``ids``. Returns the number of rows newly tombstoned.
+
+        ``version`` (one HLC version for the whole call — the client
+        stamps once per mutation) makes the delete LWW-gated: an id whose
+        live version is same-or-newer NO-OPs (the upsert outran the
+        delete — the race that used to converge to delete-wins), a replay
+        of an already-applied delete NO-OPs, and every id the delete DOES
+        win is recorded in the deletion ledger at ``version`` — including
+        ids with no local rows, so a stale add arriving later (a repair
+        re-send of a write this delete superseded) is gated too.
+        Unversioned calls keep the exact legacy delete-wins semantics.
 
         Indexed rows are masked on device immediately (one scatter under
         ``index_lock`` — the same lock every device search holds, so a
@@ -431,6 +660,7 @@ class Index:
             meta_arr0, meta_n0 = self.id_to_metadata.snapshot()
         candidates = scan(meta_arr0, 0, meta_n0)  # O(rows), lock-free
 
+        vk = _versions.version_key(version)
         with self.buffer_lock, self.index_lock:
             meta_arr, meta_n = self.id_to_metadata.snapshot()
             if self._meta_epoch != epoch0:
@@ -443,28 +673,62 @@ class Index:
                 candidates += scan(meta_arr, meta_n0, meta_n)
             indexed_n = (self.tpu_index.ntotal
                          if self.tpu_index is not None else 0)
+            eligible_keys = None
+            if vk is not None:
+                # LWW gate per requested id (not per matched row): ids
+                # the delete loses no-op; ids it wins are ledgered at vk
+                # below even when no local row carries them
+                self._observe_version_locked(vk)
+                eligible_keys, gated = set(), 0
+                for raw in id_set:
+                    k = _id_match_key(raw)
+                    if _versions.delete_loses(
+                            vk, self.tombstones.live_version(k),
+                            self.tombstones.ledger_version(k)):
+                        gated += 1
+                    else:
+                        eligible_keys.add(k)
+                self._mutation_counters["version_noop_deletes"] += gated
             rows, rids = [], []
             for p, mid in candidates:
-                if p not in self.tombstones:
-                    rows.append(p)
-                    rids.append(mid)
-            if not rows:
+                if p in self.tombstones:
+                    continue
+                if (eligible_keys is not None
+                        and _id_match_key(mid) not in eligible_keys):
+                    continue
+                rows.append(p)
+                rids.append(mid)
+            if not rows and not eligible_keys:
                 return 0
-            self._check_remove_supported_locked()
-            device_rows = [p for p in rows if p < indexed_n]
-            if device_rows:
-                # graftlint: ok(blocking-under-lock): the locked mask scatter is the tombstone consistency contract — device mutations serialize on index_lock like every launch
-                self.tpu_index.remove_rows(np.asarray(device_rows, np.int64))
-            self.tombstones.add(rows, rids)
-            payload, version = self._tombstone_payload_locked()
+            if rows:
+                self._check_remove_supported_locked()
+                device_rows = [p for p in rows if p < indexed_n]
+                if device_rows:
+                    # graftlint: ok(blocking-under-lock): the locked mask scatter is the tombstone consistency contract — device mutations serialize on index_lock like every launch
+                    self.tpu_index.remove_rows(
+                        np.asarray(device_rows, np.int64))
+                self.tombstones.add(rows, rids, version=vk)
+                if vk is None:
+                    # legacy delete-wins: a versioned live entry must not
+                    # outlive its rows (the digest compares (id, version))
+                    for mid in rids:
+                        self.tombstones.drop_live_version(mid)
+            if eligible_keys:
+                self.tombstones.ledger_update_versioned(
+                    (k, vk) for k in eligible_keys)
+                for k in eligible_keys:
+                    self.tombstones.drop_live_version(k)
+            self._digest_cache = None
+            payload, sc_version = self._tombstone_payload_locked()
             removed = len(rows)
         # durability point — AFTER the serving locks are released: the
         # sidecar rewrite+fsync must not stall concurrent searches/adds
-        self._write_tombstone_sidecar(payload, version)
+        self._write_tombstone_sidecar(payload, sc_version)
         return removed
 
     def upsert(self, ids, embeddings: np.ndarray,
-               metadata: Optional[List[object]] = None) -> int:
+               metadata: Optional[List[object]] = None,
+               version=None) -> int:
         """Delete + add: tombstone every live row carrying one of ``ids``,
         then ingest the replacement vectors through the normal add path
         (new rows get fresh positions, so they are NOT masked by the ids'
@@ -474,7 +738,13 @@ class Index:
         returns; the new rows become searchable when their buffer chunk
         drains (exactly like any add) — old and new are never both live.
         ``metadata`` defaults to ``(id,)`` tuples when the id rides at
-        metadata position 0 (the default ``custom_meta_id_idx``)."""
+        metadata position 0 (the default ``custom_meta_id_idx``).
+
+        ``version`` stamps BOTH halves with the same HLC version; the
+        LWW tie rules (add wins a tie against the ledger, loses one
+        against a live row) make the pair atomic under replay: a replayed
+        upsert's delete no-ops against its own live re-add, and its
+        re-add no-ops against the already-live row."""
         ids = list(ids)
         embeddings = np.asarray(embeddings, np.float32)
         if embeddings.shape[0] != len(ids):
@@ -487,8 +757,8 @@ class Index:
                     "upsert needs explicit metadata when "
                     "custom_meta_id_idx != 0")
             metadata = [(i,) for i in ids]
-        removed = self.remove_ids(ids)
-        self.add_batch(embeddings, metadata)
+        removed = self.remove_ids(ids, version=version)
+        self.add_batch(embeddings, metadata, version=version)
         return removed
 
     # graftlint: ok(lock-discipline): the _locked suffix is the contract — every caller holds index_lock
@@ -567,7 +837,52 @@ class Index:
         comp = self.perf.summary().get("compaction_s")
         if comp:
             out["compaction_s"] = comp
+        wm = self.version_watermark()
+        out["version_watermark"] = list(wm) if wm is not None else None
         return out
+
+    # ------------------------------------------------------------- versioning
+
+    # graftlint: ok(lock-discipline): the _locked suffix is the contract — every caller holds index_lock
+    def _observe_version_locked(self, vk) -> None:
+        """Fold one presented version into the per-writer watermark. A
+        version counts as incorporated whether it APPLIED or no-op'd —
+        a gated replay means a same-or-newer write already covers it, so
+        a read demanding ``min_version`` <= vk is answerable here."""
+        if vk is None:
+            return
+        cur = self._version_watermark.get(vk[2])
+        pair = (vk[0], vk[1])
+        if cur is None or pair > cur:
+            self._version_watermark[vk[2]] = pair
+
+    def version_watermark(self):
+        """The newest version incorporated on this shard across all
+        writers (None before any versioned mutation) — what a restarting
+        client's HLC seeds from (``get_id_sets``)."""
+        with self.index_lock:
+            items = list(self._version_watermark.items())
+        if not items:
+            return None
+        return max((ms, ctr, w) for w, (ms, ctr) in items)
+
+    def assert_min_version(self, min_version) -> None:
+        """Read-your-writes gate: raise the structured stale-read
+        rejection (group-failover-eligible, utils/state.py) when this
+        replica has not yet incorporated ``min_version``. Watermarks are
+        tracked PER WRITER — a client's own versions are monotonic, so
+        ``watermark[writer] >= (ms, counter)`` proves every write that
+        client stamped up to ``min_version`` has landed (or been
+        superseded) here; another writer's higher version can never
+        satisfy it by accident."""
+        vk = _versions.version_key(min_version)
+        if vk is None:
+            return
+        with self.index_lock:
+            wm = self._version_watermark.get(vk[2])
+        if wm is None or wm < (vk[0], vk[1]):
+            raise RuntimeError(STALE_READ_REJECTION_FMT.format(
+                version=list(vk), watermark=list(wm) if wm else None))
 
     # ----------------------------------------------------------- anti-entropy
 
@@ -597,10 +912,19 @@ class Index:
             meta_arr, meta_n = self.id_to_metadata.snapshot()
             dead_rows = frozenset(self.tombstones.rows())
             ledger = self.tombstones.ledger()
+            live_vmap = dict(self.tombstones.live_versions())
         id_idx = self.cfg.custom_meta_id_idx
-        live_sum, live_n = 0, 0
+        live_sum, live_vsum, live_n = 0, 0, 0
         for _p, mid, _m in _iter_live_ids(meta_arr, meta_n, dead_rows, id_idx):
-            live_sum = (live_sum + _id_hash(_id_match_key(mid))) & _DIGEST_MASK
+            k = _id_match_key(mid)
+            live_sum = (live_sum + _id_hash(k)) & _DIGEST_MASK
+            # versioned plane: hashing (id, version) catches content
+            # divergence under an UNCHANGED id set — the in-place upsert
+            # an id-only digest cannot see. Compared only between peers
+            # that both emit it (digests_match), so pre-version replicas
+            # keep converging on the id-only plane.
+            live_vsum = (live_vsum
+                         + _id_hash((k, live_vmap.get(k)))) & _DIGEST_MASK
             live_n += 1
         dead_sum = 0
         for k in ledger:
@@ -608,6 +932,7 @@ class Index:
         digest = {
             "live_n": live_n,
             "live_hash": format(live_sum, "032x"),
+            "live_vhash": format(live_vsum, "032x"),
             "dead_n": len(ledger),
             "dead_hash": format(dead_sum, "032x"),
         }
@@ -622,38 +947,73 @@ class Index:
         ``live`` = every live metadata id (buffered included), ``dead`` =
         the deletion ledger. Keys ride ``id_match_key`` normalization so
         replicas whose persistence histories differ (JSON sidecar
-        round-trips turn tuples into lists) still compare equal."""
+        round-trips turn tuples into lists) still compare equal.
+
+        Versioned extensions (absent = pre-version peer, handled by the
+        sweeper): ``live_versions``/``dead_versions`` are (key, version)
+        pairs for every id carrying a real version, and ``watermark`` is
+        the shard's newest incorporated version — what a restarting
+        client's HLC seeds from."""
         with self.buffer_lock, self.index_lock:
             meta_arr, meta_n = self.id_to_metadata.snapshot()
             dead_rows = frozenset(self.tombstones.rows())
-            ledger = self.tombstones.ledger()
+            ledger_items = self.tombstones.ledger_items()
+            live_vmap = dict(self.tombstones.live_versions())
         id_idx = self.cfg.custom_meta_id_idx
         live = [_id_match_key(mid) for _p, mid, _m
                 in _iter_live_ids(meta_arr, meta_n, dead_rows, id_idx)]
-        return {"live": live, "dead": sorted(ledger, key=repr)}
+        live_keys = set(live)
+        wm = self.version_watermark()
+        return {
+            "live": live,
+            "dead": sorted((k for k, _v in ledger_items), key=repr),
+            "live_versions": sorted(
+                ([k, v] for k, v in live_vmap.items()
+                 if v is not None and k in live_keys), key=repr),
+            "dead_versions": sorted(
+                ([k, v] for k, v in ledger_items if v is not None),
+                key=repr),
+            "watermark": list(wm) if wm is not None else None,
+        }
 
-    # graftlint: ok(blocking-under-lock): designed locked fetch — rows and their metadata must come from one atomic index state (repair path, never hot)
     def export_rows(self, ids) -> Tuple[np.ndarray, list]:
         """Rows for an anti-entropy delta pull: ``(embeddings, metadata)``
-        for every LIVE local row whose id is in ``ids``. One atomic
-        capture under both locks (positions must pair with the buffer
-        they index into); indexed rows come back via reconstruct (exact
-        for raw-storage kinds — flat/IVF-Flat; encoded kinds round-trip
-        through their codec, which is why large divergence on those
-        prefers the full-snapshot sync path), buffered rows verbatim."""
+        for every LIVE local row whose id is in ``ids``. Indexed rows
+        come back via reconstruct (exact for raw-storage kinds —
+        flat/IVF-Flat; encoded kinds round-trip through their codec,
+        which is why large divergence on those prefers the full-snapshot
+        sync path), buffered rows verbatim. The un-versioned wire shape,
+        kept for pre-version peers."""
+        emb, metas, _vers = self._export_rows(ids)
+        return emb, metas
+
+    def export_rows_versioned(self, ids) -> Tuple[np.ndarray, list, list]:
+        """``export_rows`` plus each row's live write version (None for
+        rows that were never versioned-written) — the pull side of a
+        versioned delta repair: the puller applies the rows through the
+        LWW add gates instead of blindly appending."""
+        return self._export_rows(ids)
+
+    # graftlint: ok(blocking-under-lock): designed locked fetch — rows and their metadata must come from one atomic index state (repair path, never hot)
+    def _export_rows(self, ids) -> Tuple[np.ndarray, list, list]:
+        """One atomic capture under both locks (positions must pair with
+        the buffer they index into) behind both export shapes."""
         want = {_id_match_key(i) for i in ids}
         with self.buffer_lock, self.index_lock:
             meta_arr, meta_n = self.id_to_metadata.snapshot()
             indexed_n = (self.tpu_index.ntotal
                          if self.tpu_index is not None else 0)
             dead_rows = frozenset(self.tombstones.rows())
+            live_vmap = dict(self.tombstones.live_versions())
             id_idx = self.cfg.custom_meta_id_idx
-            positions, metas = [], []
+            positions, metas, vers = [], [], []
             for p, mid, m in _iter_live_ids(meta_arr, meta_n,
                                             dead_rows, id_idx):
-                if _id_match_key(mid) in want:
+                k = _id_match_key(mid)
+                if k in want:
                     positions.append(p)
                     metas.append(m)
+                    vers.append(live_vmap.get(k))
             dim = int(self.cfg.dim)
             # the buffer concatenate is O(buffered rows) under both locks:
             # pay it only when a wanted row is actually still buffered
@@ -680,35 +1040,81 @@ class Index:
         if not keep.all():
             out = out[keep]
             metas = [m for j, m in enumerate(metas) if keep[j]]
-        return out, metas
+            vers = [v for j, v in enumerate(vers) if keep[j]]
+        return out, metas, vers
 
-    def reconcile_deletes(self, dead_keys) -> int:
-        """Apply a peer's deletion ledger: tombstone every live local row
-        whose id the peer has deleted (delete-wins — the documented
-        conservative rule: a delete must never resurrect; re-ingest
-        restores an upsert), and record EVERY peer key in the local
-        ledger — durable before return, like any delete — so a stale
-        repair re-send can never be pulled back by a later sweep.
-        Returns the rows newly tombstoned."""
+    def reconcile_deletes(self, dead_keys, dead_versions=None) -> int:
+        """Apply a peer's deletion ledger. Versioned (``dead_versions``:
+        (key, version) pairs from the peer's id_sets): each delete is
+        LWW-gated — a local live write at a same-or-newer version WINS
+        (the upsert-vs-delete race converges to the true last writer
+        instead of delete-wins), an unversioned local live row loses to
+        any versioned delete, and every peer key is max-merged into the
+        local ledger — durable before return, like any delete — so a
+        stale repair re-send can never be pulled back by a later sweep.
+        Unversioned peer keys keep the legacy conservative rule
+        (delete-wins) EXCEPT against a versioned local live row, which a
+        minimal unversioned delete can never outrank. Returns the rows
+        newly tombstoned."""
         keys = {_id_match_key(k) for k in dead_keys}
         if not keys:
             return 0
+        vmap = {}
+        for k, v in (dead_versions or ()):
+            vmap[_id_match_key(k)] = _versions.version_key(v)
         with self.buffer_lock, self.index_lock:
             meta_arr, meta_n = self.id_to_metadata.snapshot()
             dead_rows = frozenset(self.tombstones.rows())
+            live_vmap = dict(self.tombstones.live_versions())
         id_idx = self.cfg.custom_meta_id_idx
-        raw = [mid for _p, mid, _m
-               in _iter_live_ids(meta_arr, meta_n, dead_rows, id_idx)
-               if _id_match_key(mid) in keys]
-        removed = self.remove_ids(raw) if raw else 0
+        raw_by_version, legacy_raw, gated = {}, [], 0
+        for _p, mid, _m in _iter_live_ids(meta_arr, meta_n,
+                                          dead_rows, id_idx):
+            k = _id_match_key(mid)
+            if k not in keys:
+                continue
+            vd = vmap.get(k)
+            if vd is None:
+                # unversioned peer delete: legacy delete-wins, EXCEPT a
+                # versioned local live write outranks the minimal stamp
+                if live_vmap.get(k) is not None:
+                    gated += 1
+                else:
+                    legacy_raw.append(mid)
+            else:
+                raw_by_version.setdefault(vd, []).append(mid)
+        removed = self.remove_ids(legacy_raw) if legacy_raw else 0
+        for vd, raws in sorted(raw_by_version.items()):
+            # versioned removal re-gates UNDER the engine locks (the
+            # snapshot above is only a partition): a newer upsert that
+            # landed between the snapshot and this point keeps its rows —
+            # feeding these ids through an UNVERSIONED remove here would
+            # re-open the delete-wins race inside the very mechanism
+            # built to close it. One call per distinct peer version
+            # (ledger versions come from whole-batch client stamps, so
+            # the group count tracks delete calls, not ids).
+            removed += self.remove_ids(raws, version=vd)
         with self.buffer_lock, self.index_lock:
-            if self.tombstones.ledger_update(keys):
+            if gated:
+                self._mutation_counters["version_noop_deletes"] += gated
+            changed = self.tombstones.ledger_update_versioned(
+                (k, vmap.get(k)) for k in keys
+                # never ledger a key a local live write just outranked at
+                # the SAME version plane it holds: recording (k, v<=live)
+                # is harmless, but skipping keys whose live version wins
+                # keeps the ledger from accumulating strictly-stale pairs
+                if not (live_vmap.get(k) is not None
+                        and _versions.compare(live_vmap.get(k),
+                                              vmap.get(k)) >= 0))
+            for vk in vmap.values():
+                self._observe_version_locked(vk)
+            if changed:
                 self._digest_cache = None
-                payload, version = self._tombstone_payload_locked()
+                payload, sc_version = self._tombstone_payload_locked()
             else:
                 payload = None
         if payload is not None:
-            self._write_tombstone_sidecar(payload, version)
+            self._write_tombstone_sidecar(payload, sc_version)
         return removed
 
     def compact(self) -> bool:
@@ -798,8 +1204,13 @@ class Index:
             new_tomb = TombstoneSet(carried)
             # the deletion ledger is position-free and must SURVIVE the
             # swap: compaction reclaims rows, never forgets that their
-            # ids were deleted (the anti-entropy resurrect guard)
-            new_tomb.ledger_update(self.tombstones.ledger())
+            # ids were deleted (the anti-entropy resurrect guard) — and
+            # since ISSUE 12 both version planes ride along: delete
+            # versions in the ledger, live write versions beside it (a
+            # compaction must not demote a versioned row to legacy, or a
+            # stale delete would win against it afterwards)
+            new_tomb.ledger_update_versioned(self.tombstones.ledger_items())
+            new_tomb.live_versions_update(self.tombstones.live_versions())
             if any(r < new_n for r in carried):
                 # graftlint: ok(blocking-under-lock): locked mask scatter (tombstone consistency contract)
                 new_index.remove_rows(np.asarray(
@@ -823,12 +1234,14 @@ class Index:
                 extra={"ntotal": new_n, "layout": gen, "compacted": True},
                 tombstones=new_tomb.to_payload(),
                 io_lock=self._tombstone_io_lock,
+                keep=self.versioning.retain_generations,
             )
             self.tpu_index = new_index
             self.id_to_metadata = _MetaStore(new_meta)
             self.tombstones = new_tomb
             self._generation = gen
             self.index_saved_size = new_n
+            self._saved_tombstone_version = self._tombstone_version
             self.index_save_time = time.time()
             self._meta_epoch += 1  # in-flight joins retry on the new layout
             self._mutation_counters["compactions"] += 1
@@ -1115,6 +1528,94 @@ class Index:
             run = lambda: self._search_reconstruct(query_batch, top_k)
         return self._run_and_join(run, return_embeddings)
 
+    # ------------------------------------------------- generation-pinned reads
+
+    def current_generation(self) -> int:
+        """Newest committed snapshot generation of this shard (0 = none
+        committed yet) — what a client pins for point-in-time reads."""
+        with self.index_lock:
+            return self._generation
+
+    # graftlint: ok(blocking-under-lock): pinned-snapshot launches serialize on their own leaf lock by design — the snapshot index is private to this path and never contends with the serving locks
+    def search_at_generation(self, query_batch: np.ndarray, top_k: int = 100,
+                             generation: int = 0,
+                             return_embeddings: bool = False):
+        """Point-in-time search against a RETAINED committed generation:
+        results reflect exactly the rows (and tombstones) of snapshot
+        ``generation``, regardless of every mutation since — the read
+        mode the reference system cannot express at all. The snapshot is
+        loaded lazily from the generation's manifest files (one cached at
+        a time under ``_pinned_lock``; raise ``DFT_RETAIN_GENERATIONS``
+        to keep a deeper window) and serves the generation's INDEXED
+        rows — its buffered-but-unindexed tail is not searchable, same as
+        it was not searchable when the generation was committed. Pruned
+        or unknown generations raise a clear application error so a
+        client can walk to a replica that still retains them."""
+        query_batch = np.asarray(query_batch, np.float32)
+        gen = int(generation)
+        with self._pinned_lock:
+            cached = self._pinned_cache
+            if cached is None or cached[0] != gen:
+                self._pinned_cache = cached = (
+                    gen, self._load_generation_snapshot(gen))
+            snap_index, meta_arr, meta_n = cached[1]
+            scores, indexes = snap_index.search(query_batch, top_k)
+            embs_arr = None
+            if return_embeddings:
+                flat = indexes.reshape(-1)
+                if snap_index.ntotal == 0:
+                    rec = np.zeros((flat.shape[0], query_batch.shape[1]),
+                                   np.float32)
+                else:
+                    safe = np.where(flat >= 0, flat, 0)
+                    rec = np.array(snap_index.reconstruct_batch(safe))
+                    rec[flat < 0] = 0.0
+                embs_arr = rec.reshape(indexes.shape + (query_batch.shape[1],))
+        return self._join_results(scores, indexes, embs_arr,
+                                  return_embeddings, meta_arr, meta_n)
+
+    def _load_generation_snapshot(self, gen: int):
+        """Load one retained generation read-only: verified manifest
+        files -> (index, meta array, meta length) with the generation's
+        OWN tombstone sidecar applied (a pinned read honors exactly the
+        deletes committed with it — later deletes are the point of
+        pinning). Memory note: this is a second resident copy of the
+        shard; the cache holds ONE generation at a time."""
+        storage_dir = self.cfg.index_storage_dir
+        if not storage_dir:
+            raise RuntimeError(
+                "generation-pinned reads need a persistent shard "
+                "(no index_storage_dir configured)")
+        manifest = None
+        for g, mpath in serialization.list_generations(storage_dir):
+            if g == gen:
+                manifest = serialization.load_manifest(mpath)
+                break
+        if manifest is None:
+            raise RuntimeError(
+                f"generation {gen} is not retained at {storage_dir} "
+                "(pruned or never committed; raise DFT_RETAIN_GENERATIONS "
+                "to keep a deeper point-in-time window)")
+
+        def gen_path(key):
+            return os.path.join(storage_dir, manifest["files"][key]["name"])
+
+        snap_index = index_from_state_dict(load_state(gen_path("index")))
+        with open(gen_path("meta"), "rb") as f:
+            meta = pickle.load(f)
+        meta = meta[: snap_index.ntotal]
+        tomb = TombstoneSet.from_payload(
+            _tombstones.load_generation_payload(storage_dir, manifest))
+        dead = [p for p in tomb.rows() if p < snap_index.ntotal]
+        if dead:
+            snap_index.remove_rows(np.asarray(dead, np.int64))
+        store = _MetaStore(meta)
+        meta_arr, meta_n = store.snapshot()
+        logger.info("pinned generation %d of %s for point-in-time reads "
+                    "(%d rows, %d tombstoned)", gen, storage_dir,
+                    snap_index.ntotal, len(dead))
+        return snap_index, meta_arr, meta_n
+
     # graftlint: ok(blocking-under-lock): deliberate locked launches — ids and reconstructed embeddings must come from one atomic index state
     def _search_reconstruct(self, query_batch: np.ndarray, top_k: int):
         """Search + embedding reconstruction. Embeddings must come from the
@@ -1257,7 +1758,10 @@ class Index:
                 return False
 
         with self.buffer_lock, self.index_lock:
-            if self.tpu_index is None or self.tpu_index.ntotal == self.index_saved_size:
+            if self.tpu_index is None or (
+                    self.tpu_index.ntotal == self.index_saved_size
+                    and self._tombstone_version
+                    == self._saved_tombstone_version):
                 return False
             storage_dir = self.cfg.index_storage_dir
 
@@ -1280,10 +1784,12 @@ class Index:
                        "layout": self.tombstones.layout},
                 tombstones=self.tombstones.to_payload(),
                 io_lock=self._tombstone_io_lock,
+                keep=self.versioning.retain_generations,
             )
             self._generation = gen
 
             self.index_saved_size = self.tpu_index.ntotal
+            self._saved_tombstone_version = self._tombstone_version
             self.index_save_time = time.time()
             logger.info("saved index (%d vectors) to %s as generation %d",
                         self.index_saved_size, storage_dir, gen)
@@ -1294,7 +1800,7 @@ class Index:
                            meta: list, buffer: list, cfg: IndexCfg,
                            extra: Optional[dict] = None,
                            tombstones: Optional[dict] = None,
-                           io_lock=None) -> None:
+                           io_lock=None, keep: int = 2) -> None:
         """ONE copy of the torn-snapshot commit protocol, shared by the
         normal save path, compaction, and the shard-transfer import: every
         file of generation ``gen`` is written atomically
@@ -1312,7 +1818,9 @@ class Index:
         pair (mutation/tombstones.py). Also refreshes the unversioned
         cfg.json convenience copy (get_config_path readers expect the
         fixed name; it is NOT part of the committed set) and prunes to the
-        newest 2 generations."""
+        newest ``keep`` generations (floored at 2 — the crash-fallback
+        pair; instance callers pass ``versioning.retain_generations``, the
+        point-in-time read window)."""
         os.makedirs(storage_dir, exist_ok=True)
         ts_payload = (tombstones if tombstones is not None
                       else TombstoneSet().to_payload())
@@ -1349,7 +1857,10 @@ class Index:
             os.path.join(storage_dir, "cfg.json"),
             lambda f: f.write(cfg.to_json_string() + "\n"), "w",
         )
-        serialization.prune_generations(storage_dir, keep=2)
+        # retained-generation bound (DFT_RETAIN_GENERATIONS): beyond the
+        # crash-fallback pair, extra retained generations are the
+        # point-in-time read window for search_at_generation
+        serialization.prune_generations(storage_dir, keep=max(2, int(keep)))
 
     # ------------------------------------------------------- shard transfer
 
@@ -1410,6 +1921,9 @@ class Index:
             # nothing trained at the source: replay the raw buffer
             result = cls(cfg)
             result.tombstones = tomb
+            # watermarks only: the rows are about to be replayed below,
+            # so live-version entries are NOT stale here
+            result._seed_version_state(prune=False)
             offset = 0
             for chunk in buffer:
                 n = chunk.shape[0]
@@ -1426,6 +1940,7 @@ class Index:
             extra={"ntotal": int(tpu_index.ntotal), "transferred": True,
                    "layout": tomb.layout},
             tombstones=tomb.to_payload(),
+            keep=VersioningCfg.from_env().retain_generations,
         )
         logger.info(
             "imported transferred shard (%d vectors, %d buffered) into %s "
@@ -1592,7 +2107,33 @@ class Index:
                     len(meta), tpu_index.ntotal + buffer_size,
                 )
             result.id_to_metadata = _MetaStore(meta[: tpu_index.ntotal])
+        result._seed_version_state()
         return result
+
+    def _seed_version_state(self, prune: bool = True) -> None:
+        """Post-restore version bookkeeping: re-seed the per-writer
+        watermarks from the recovered version planes, and (``prune``)
+        drop live-version entries whose rows did not survive the restore
+        (a truncated buffer) — a live version without a live row would
+        gate the anti-entropy re-pull of that very row forever."""
+        with self.buffer_lock, self.index_lock:
+            pairs = (self.tombstones.ledger_items()
+                     + self.tombstones.live_versions())
+            if not pairs:
+                return
+            for _k, v in pairs:
+                self._observe_version_locked(_versions.version_key(v))
+            live_pairs = self.tombstones.live_versions()
+            if not prune or not live_pairs:
+                return
+            meta_arr, meta_n = self.id_to_metadata.snapshot()
+            dead_rows = frozenset(self.tombstones.rows())
+            id_idx = self.cfg.custom_meta_id_idx
+            live_keys = {_id_match_key(mid) for _p, mid, _m in
+                         _iter_live_ids(meta_arr, meta_n, dead_rows, id_idx)}
+            for k, _v in live_pairs:
+                if k not in live_keys:
+                    self.tombstones.drop_live_version(k)
 
     def _run_save_watcher(self) -> None:
         def _watch(idx: "Index"):

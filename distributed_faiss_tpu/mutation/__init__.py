@@ -32,6 +32,13 @@ Layering:
   ``remove_ids``/``upsert`` fan out per replica group under the quorum
   machinery; below-quorum deletes land in the repair queue (never
   rerouted cross-group), and ``get_perf_stats`` grows a ``mutation`` key.
+- per-id versions (versions.py, ISSUE 12): every mutation carries a
+  hybrid-logical-clock stamp, and the engine's apply sites run the LWW
+  gates — replays no-op, upsert-vs-delete races converge to the true
+  last writer, replica digests/deltas compare versioned state, and
+  per-writer watermarks back read-your-writes plus generation-pinned
+  point-in-time reads
+  (docs/OPERATIONS.md#versioned-mutations--consistent-reads).
 """
 
 from distributed_faiss_tpu.mutation.tombstones import (  # noqa: F401
@@ -44,4 +51,10 @@ from distributed_faiss_tpu.mutation.compaction import (  # noqa: F401
     CompactionUnsupported,
     compact_state,
     run_watcher,
+)
+from distributed_faiss_tpu.mutation.versions import (  # noqa: F401
+    HLC,
+    add_loses,
+    delete_loses,
+    version_key,
 )

@@ -34,11 +34,19 @@ import json
 import os
 from typing import Dict, Iterable, Optional
 
+from distributed_faiss_tpu.mutation import versions as _versions
 from distributed_faiss_tpu.utils import serialization
 
 SIDECAR_NAME = "tombstones.json"
 
-PAYLOAD_FORMAT = 1
+# format 2 (ISSUE 12): the deletion ledger carries per-id delete versions
+# (``dead_versions``) and the payload gains the per-id LIVE write
+# versions (``live_versions``) — the state the LWW gates compare. Format
+# 1 payloads load with every version None (legacy seeding: unversioned
+# compares below any real version, so legacy delete-wins semantics are
+# the degenerate case); a format-1 READER of a format-2 payload sees the
+# same ``dead_rows``/``dead_ids``/``dead_ledger`` keys it always did.
+PAYLOAD_FORMAT = 2
 
 
 def id_match_key(v):
@@ -74,7 +82,7 @@ class TombstoneSet:
     delete-then-readd converges to live everywhere.
     """
 
-    __slots__ = ("_rows", "layout", "_ledger")
+    __slots__ = ("_rows", "layout", "_ledger", "_live_versions")
 
     def __init__(self, rows: Optional[Dict[int, object]] = None,
                  layout: int = 0, ledger=None,
@@ -82,7 +90,20 @@ class TombstoneSet:
         self._rows: Dict[int, object] = (
             {int(r): v for r, v in rows.items()} if rows else {})
         self.layout = int(layout)
-        self._ledger = {id_match_key(k) for k in ledger} if ledger else set()
+        # deletion ledger: normalized id key -> version of the delete
+        # (None for legacy/unversioned deletes). ``ledger`` accepts BARE
+        # keys only (version None) — versioned pairs go through
+        # ``ledger_update_versioned`` (a pair passed here would be
+        # normalized as a tuple id and never match its key again).
+        self._ledger: Dict[object, object] = {}
+        if ledger:
+            self.ledger_update(ledger)
+        # per-id LIVE write versions (the other half of the LWW state):
+        # normalized id key -> version of the last versioned add/upsert
+        # that made the id live here. Position-free like the ledger —
+        # survives compaction, persists in every payload. Unversioned
+        # adds leave no entry (None = legacy).
+        self._live_versions: Dict[object, object] = {}
         # seed the ledger from the positional dead ids: right for direct
         # construction (a dead row's id was deleted) and for PRE-ledger
         # payloads — but a payload that CARRIES a dead_ledger is
@@ -94,7 +115,7 @@ class TombstoneSet:
         if seed_ledger_from_rows:
             for v in self._rows.values():
                 if v is not None:
-                    self._ledger.add(id_match_key(v))
+                    self._ledger.setdefault(id_match_key(v), None)
 
     def __len__(self) -> int:
         return len(self._rows)
@@ -115,15 +136,22 @@ class TombstoneSet:
         """(row, user id) pairs (copy — safe outside the lock)."""
         return list(self._rows.items())
 
-    def add(self, rows: Iterable[int], ids: Optional[Iterable] = None) -> None:
+    def add(self, rows: Iterable[int], ids: Optional[Iterable] = None,
+            version=None) -> None:
+        """Record dead rows. ``version`` (optional) stamps the ledger
+        entries of the ids — the delete's LWW version; None keeps the
+        legacy unversioned entry (which never outranks a real version)."""
         if ids is None:
             for r in rows:
                 self._rows.setdefault(int(r), None)
             return
+        version = _versions.version_key(version)
         for r, i in zip(rows, ids):
             self._rows[int(r)] = i
             if i is not None:
-                self._ledger.add(id_match_key(i))
+                k = id_match_key(i)
+                self._ledger[k] = _versions.newest(self._ledger.get(k),
+                                                   version)
 
     # ------------------------------------------------------ deletion ledger
 
@@ -136,12 +164,35 @@ class TombstoneSet:
     def ledger_size(self) -> int:
         return len(self._ledger)
 
+    def ledger_version(self, key):
+        """The recorded delete version for one (raw or normalized) id —
+        None when unledgered OR ledgered unversioned (the LWW gates
+        treat both as minimal)."""
+        return self._ledger.get(id_match_key(key))
+
+    def ledger_items(self) -> list:
+        """(normalized key, delete version) pairs (copy — safe outside
+        the lock)."""
+        return list(self._ledger.items())
+
     def ledger_update(self, keys: Iterable) -> int:
-        """Record peer-observed deletions (already-normalized keys or raw
-        ids). Returns how many keys were new."""
+        """Record peer-observed deletions (already-normalized keys or
+        raw ids; version None — see ``ledger_update_versioned`` for the
+        stamped variant). Returns how many keys were new."""
         before = len(self._ledger)
         for k in keys:
-            self._ledger.add(id_match_key(k))
+            self._ledger.setdefault(id_match_key(k), None)
+        return len(self._ledger) - before
+
+    def ledger_update_versioned(self, pairs: Iterable) -> int:
+        """Record ``(key, version)`` deletion pairs — versions max-merge,
+        so a replayed older delete can never roll a newer one back.
+        Returns how many keys were new."""
+        before = len(self._ledger)
+        for k, v in pairs:
+            kk = id_match_key(k)
+            self._ledger[kk] = _versions.newest(
+                self._ledger.get(kk), _versions.version_key(v))
         return len(self._ledger) - before
 
     def unledger(self, keys: Iterable) -> int:
@@ -151,9 +202,47 @@ class TombstoneSet:
         for k in keys:
             kk = id_match_key(k)
             if kk in self._ledger:
-                self._ledger.discard(kk)
+                del self._ledger[kk]
                 hit += 1
         return hit
+
+    # ---------------------------------------------------- live versions
+
+    def live_version(self, key):
+        """The last versioned write that made this id live here (None =
+        never versioned-written, or deleted since)."""
+        return self._live_versions.get(id_match_key(key))
+
+    def set_live_version(self, key, version) -> None:
+        self._live_versions[id_match_key(key)] = _versions.version_key(
+            version)
+
+    def drop_live_version(self, key) -> None:
+        self._live_versions.pop(id_match_key(key), None)
+
+    def live_versions(self) -> list:
+        """(normalized key, version) pairs (copy — safe outside the
+        lock)."""
+        return list(self._live_versions.items())
+
+    def live_versions_update(self, pairs: Iterable) -> None:
+        """Max-merge (key, version) pairs in (compaction carry-over,
+        payload merge)."""
+        for k, v in pairs:
+            kk = id_match_key(k)
+            self._live_versions[kk] = _versions.newest(
+                self._live_versions.get(kk), _versions.version_key(v))
+
+    def max_version(self):
+        """The newest version recorded anywhere in this set (live or
+        ledger) — the shard's restart watermark seed. None when nothing
+        versioned was ever applied."""
+        out = None
+        for v in self._ledger.values():
+            out = _versions.newest(out, v)
+        for v in self._live_versions.values():
+            out = _versions.newest(out, v)
+        return out
 
     def count_below(self, n: int) -> int:
         """Dead rows with position < n (i.e. already indexed rows)."""
@@ -171,8 +260,17 @@ class TombstoneSet:
             "dead_rows": rows,
             "dead_ids": [self._rows[r] for r in rows],
             # position-free: survives compaction and layout swaps; JSON
-            # round-trips tuples as lists, re-normalized at load
+            # round-trips tuples as lists, re-normalized at load.
+            # dead_ledger keeps its format-1 shape (bare keys) so a
+            # format-1 reader of this payload still recovers the ledger;
+            # the versions ride in the format-2 pair lists beside it
             "dead_ledger": sorted(self._ledger, key=repr),
+            "dead_versions": sorted(
+                ([k, v] for k, v in self._ledger.items() if v is not None),
+                key=repr),
+            "live_versions": sorted(
+                ([k, v] for k, v in self._live_versions.items()
+                 if v is not None), key=repr),
         }
 
     @classmethod
@@ -184,12 +282,18 @@ class TombstoneSet:
         mapping = dict.fromkeys(rows)
         for r, i in zip(rows, ids):
             mapping[r] = i
-        return cls(mapping, layout=int(payload.get("layout", 0)),
-                   ledger=payload.get("dead_ledger", ()),
-                   # a payload that carries the ledger key is
-                   # authoritative (even when empty) — only pre-ledger
-                   # payloads seed from dead_ids
-                   seed_ledger_from_rows="dead_ledger" not in payload)
+        out = cls(mapping, layout=int(payload.get("layout", 0)),
+                  ledger=payload.get("dead_ledger", ()),
+                  # a payload that carries the ledger key is
+                  # authoritative (even when empty) — only pre-ledger
+                  # payloads seed from dead_ids
+                  seed_ledger_from_rows="dead_ledger" not in payload)
+        # format-2 version planes: absent on legacy payloads, in which
+        # case everything stays version-None (unversioned is minimal, so
+        # legacy state correctly loses to any later versioned write)
+        out.ledger_update_versioned(payload.get("dead_versions", ()))
+        out.live_versions_update(payload.get("live_versions", ()))
+        return out
 
     def merge_payload(self, payload: Optional[dict]) -> None:
         """Union another payload's rows in (same-layout sidecar merge)."""
@@ -198,7 +302,9 @@ class TombstoneSet:
         other = TombstoneSet.from_payload(payload)
         for r, i in other._rows.items():
             self._rows.setdefault(r, i)
-        self._ledger |= other._ledger
+        for k, v in other._ledger.items():
+            self._ledger[k] = _versions.newest(self._ledger.get(k), v)
+        self.live_versions_update(other._live_versions.items())
 
     def __repr__(self) -> str:
         return f"<TombstoneSet {len(self._rows)} dead, layout {self.layout}>"

@@ -1,0 +1,148 @@
+"""Per-id mutation versions: hybrid logical clocks and the LWW gates.
+
+Through PR 10 the mutation/replication stack had one standing correctness
+residual (ROADMAP item 6): reconciliation was **delete-wins**. An upsert
+racing anti-entropy against a replica that only saw the delete converged
+to *deleted* until re-ingested, and a replayed repair-queue record (or a
+duplicated quorum fan-out) double-applied. This module is the fix's
+foundation: every mutation is stamped with a **version** — a hybrid
+logical clock (HLC) reading — and every engine-side apply site compares
+versions instead of assuming arrival order.
+
+A version is a 3-tuple ``(wall_ms, counter, writer_id)``:
+
+- ``wall_ms`` — the stamping client's wall clock in integer milliseconds,
+  advanced to at least one past the largest version ever *observed*
+  (HLC merge), so a client whose wall clock is behind the cluster still
+  stamps ahead of everything it has seen;
+- ``counter`` — the logical component: increments when several stamps
+  land in one millisecond;
+- ``writer_id`` — a per-client tie-break so versions form a TOTAL order
+  (two clients stamping in the same millisecond never compare equal).
+
+Versions are plain tuples on the wire and in JSON sidecars (lists after
+a JSON round trip — ``version_key`` re-normalizes), and ``None`` means
+*unversioned*: a legacy writer or a pre-version payload. ``None``
+compares below every real version, which makes the legacy semantics
+(delete always wins, re-ingest always restores) the correct degenerate
+case of the LWW gates below.
+
+The LWW gates (one place, so the engine's apply sites cannot drift):
+
+- ``add_loses(v, live, dead)`` — a versioned add/upsert-re-add is a
+  **no-op** when the id is already live at ``>= v`` (idempotent replay:
+  repair re-sends, duplicated quorum fan-outs) or was deleted at
+  ``> v`` (the delete is the last writer). Ties go to the ADD so an
+  upsert that reuses its delete's version still lands its re-add.
+- ``delete_loses(v, live, dead)`` — a versioned delete is a **no-op**
+  when the id is live at ``>= v`` (an upsert outran it — the race that
+  used to converge to deleted) or already deleted at ``>= v`` (replay).
+"""
+
+import os
+import threading
+import time
+from typing import Optional, Tuple
+
+from distributed_faiss_tpu.utils import lockdep
+
+Version = Tuple[int, int, int]
+
+
+def version_key(v) -> Optional[Version]:
+    """Normalize a version from any carrier (wire tuple, JSON list,
+    already-normalized tuple) to the canonical comparable 3-tuple;
+    ``None`` (unversioned) passes through."""
+    if v is None:
+        return None
+    if isinstance(v, (list, tuple)) and len(v) == 3:
+        return (int(v[0]), int(v[1]), int(v[2]))
+    raise ValueError(f"not a version: {v!r}")
+
+
+def compare(a, b) -> int:
+    """Total order over versions with ``None`` (unversioned) minimal:
+    -1 when a < b, 0 when equal, 1 when a > b."""
+    ka, kb = version_key(a), version_key(b)
+    if ka is None and kb is None:
+        return 0
+    if ka is None:
+        return -1
+    if kb is None:
+        return 1
+    return (ka > kb) - (ka < kb)
+
+
+def newest(a, b):
+    """The larger of two versions (None minimal)."""
+    return a if compare(a, b) >= 0 else b
+
+
+def add_loses(v, live, dead) -> bool:
+    """True when a versioned add of an id must NO-OP: the id is already
+    live at the same-or-newer version (idempotent replay) or a strictly
+    newer delete won. ``v`` must be a real version; ``live``/``dead``
+    are the id's current live/deletion-ledger versions (None = absent
+    or unversioned)."""
+    return compare(live, v) >= 0 or compare(dead, v) > 0
+
+
+def delete_loses(v, live, dead) -> bool:
+    """True when a versioned delete must NO-OP: a same-or-newer live
+    write (upsert) won, or the delete is a replay of one already
+    applied."""
+    return compare(live, v) >= 0 or compare(dead, v) >= 0
+
+
+class HLC:
+    """Hybrid logical clock: one per writing client (``IndexClient``).
+
+    ``tick()`` returns a fresh version strictly greater than every
+    version this clock has ticked or observed. ``observe(v)`` merges a
+    remote version in — the restart story: a client seeds its clock from
+    the max version visible in the cluster (``get_id_sets`` watermarks),
+    so a machine whose wall clock went BACKWARD across the restart still
+    stamps ahead of its own pre-restart writes instead of issuing stale
+    stamps that every replica would no-op. Thread-safe."""
+
+    def __init__(self, writer_id: Optional[int] = None,
+                 clock_ms=None):
+        # writer ids only need to distinguish concurrent writers; pid
+        # xor a time-derived salt is enough without coordination
+        if writer_id is None:
+            writer_id = (os.getpid() << 16) ^ (time.time_ns() & 0xFFFF)
+        self.writer_id = int(writer_id) & 0x7FFFFFFF
+        self._clock_ms = clock_ms or (lambda: time.time_ns() // 1_000_000)
+        self._lock = lockdep.lock("HLC._lock")
+        self._last_ms = 0
+        self._counter = 0
+
+    def tick(self) -> Version:
+        with self._lock:
+            now = int(self._clock_ms())
+            if now > self._last_ms:
+                self._last_ms = now
+                self._counter = 0
+            else:
+                self._counter += 1
+            return (self._last_ms, self._counter, self.writer_id)
+
+    def observe(self, v) -> None:
+        """Merge a remote version: subsequent ticks compare above it."""
+        k = version_key(v)
+        if k is None:
+            return
+        with self._lock:
+            if k[0] > self._last_ms:
+                self._last_ms = k[0]
+                self._counter = k[1]
+            elif k[0] == self._last_ms and k[1] > self._counter:
+                self._counter = k[1]
+
+    def last(self) -> Optional[Version]:
+        """The newest instant this clock has ticked or observed (None
+        before the first tick/observe) — NOT a fresh stamp."""
+        with self._lock:
+            if self._last_ms == 0:
+                return None
+            return (self._last_ms, self._counter, self.writer_id)

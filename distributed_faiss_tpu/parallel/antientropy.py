@@ -58,6 +58,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from distributed_faiss_tpu.mutation import versions as _versions
 from distributed_faiss_tpu.mutation.tombstones import id_match_key
 from distributed_faiss_tpu.parallel import replication, rpc
 from distributed_faiss_tpu.utils import lockdep
@@ -108,11 +109,19 @@ def digests_match(mine: Optional[dict], theirs: Optional[dict]) -> bool:
     """Convergence comparison: the LIVE side only. Dead-side fields
     (ledger hash/count) are informational — ledgers legitimately differ
     between converged replicas (a delete for an id a replica never held
-    records nothing there), so comparing them would mismatch forever."""
+    records nothing there), so comparing them would mismatch forever.
+    The versioned plane (``live_vhash``, hashing (id, write version))
+    compares only when BOTH sides emit it: two version-aware replicas
+    additionally converge on row CONTENT under an unchanged id set (the
+    in-place upsert an id-only digest cannot see), while a pre-version
+    peer keeps converging on the id plane alone."""
     if not isinstance(mine, dict) or not isinstance(theirs, dict):
         return False
-    return (mine.get("live_n") == theirs.get("live_n")
-            and mine.get("live_hash") == theirs.get("live_hash"))
+    if (mine.get("live_n") != theirs.get("live_n")
+            or mine.get("live_hash") != theirs.get("live_hash")):
+        return False
+    mv, tv = mine.get("live_vhash"), theirs.get("live_vhash")
+    return mv is None or tv is None or mv == tv
 
 
 class HealthTable:
@@ -223,7 +232,8 @@ class AntiEntropySweeper:
         self._lock = lockdep.lock("AntiEntropySweeper._lock")
         self._counters = {"sweeps": 0, "digests_matched": 0,
                           "digests_mismatched": 0, "rows_repaired": 0,
-                          "full_syncs": 0, "empty_deltas": 0}
+                          "rows_refreshed": 0, "full_syncs": 0,
+                          "empty_deltas": 0}
         self._last_empty_warn = float("-inf")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -360,13 +370,22 @@ class AntiEntropySweeper:
         """Pull this rank's missing state for one index from one peer.
 
         Order is load-bearing: the peer's deletion ledger applies FIRST
-        (delete-wins, durable before any pull), then the id-set delta
-        decides between a row pull (export_rows) and the full-snapshot
-        path. Full sync REPLACES the local engine, so it is only safe
-        when nothing local-only exists — no local-only live row, no local
-        delete the peer has not recorded; otherwise even a large
-        divergence heals by (chunked) delta, and the peer's own sweep
-        pulls the other direction."""
+        (LWW-gated since ISSUE 12 — a local live write at a same-or-newer
+        version outranks the peer's delete, so an upsert racing the sweep
+        converges to the true last writer instead of delete-wins; both
+        durable before any pull), then the id-set delta decides between a
+        row pull and the full-snapshot path. A version-aware peer also
+        yields REFRESH pulls: ids live on both sides where the peer's
+        write version is strictly newer (an in-place upsert the id-only
+        delta could never see) re-pull through the engine's LWW add
+        gates, which replace the stale local row. Full sync REPLACES the
+        local engine, so it is only safe when nothing local-only exists —
+        no local-only live row, no local delete the peer has not
+        recorded, no local write NEWER than the peer's, and no local
+        live write that just OUTRANKED a peer delete (the peer snapshot
+        holds that id deleted); otherwise even a large divergence heals
+        by (chunked) delta, and the peer's own sweep pulls the other
+        direction."""
         peer = rpc.Client(-1, host, port, connect_timeout=5.0, mux=False)
         try:
             sets = peer.generic_fun("get_id_sets", (index_id,),
@@ -374,45 +393,106 @@ class AntiEntropySweeper:
             mine = engine.id_sets()
             my_live = {id_match_key(k) for k in mine["live"]}
             my_dead = {id_match_key(k) for k in mine["dead"]}
+            my_live_v = {id_match_key(k): _versions.version_key(v)
+                         for k, v in mine.get("live_versions") or ()}
+            my_dead_v = {id_match_key(k): _versions.version_key(v)
+                         for k, v in mine.get("dead_versions") or ()}
             peer_live_raw = list(sets.get("live") or ())
             peer_dead = [id_match_key(k) for k in sets.get("dead") or ()]
-            removed = engine.reconcile_deletes(peer_dead) if peer_dead else 0
+            # a peer emitting the version planes speaks the versioned
+            # delta (export_rows_versioned); a pre-version peer heals on
+            # the legacy id-set delta unchanged
+            peer_versioned = ("live_versions" in sets
+                              or "watermark" in sets)
+            peer_live_v = {id_match_key(k): _versions.version_key(v)
+                           for k, v in sets.get("live_versions") or ()}
+            peer_dead_v = {id_match_key(k): _versions.version_key(v)
+                           for k, v in sets.get("dead_versions") or ()}
+            removed = (engine.reconcile_deletes(
+                peer_dead, sets.get("dead_versions"))
+                if peer_dead else 0)
+            # peer deletes our live write OUTRANKED (the delete_loses
+            # gate): k stays live here but is in the peer's dead set, so
+            # neither local_only (subtracts peer_dead) nor local_newer
+            # (needs k peer-live) sees it — yet a full sync would install
+            # the peer's snapshot with k DELETED, losing the winning
+            # write. Counted separately to veto full sync below.
+            gated_deletes = sum(
+                1 for k in set(peer_dead)
+                if k in my_live and my_live_v.get(k) is not None
+                and _versions.compare(my_live_v.get(k),
+                                      peer_dead_v.get(k)) >= 0)
             my_dead |= set(peer_dead)
-            missing, seen = [], set()
+            missing, refresh, seen = [], [], set()
             peer_live_keys = set()
+            local_newer = 0
             for raw in peer_live_raw:
                 k = id_match_key(raw)
                 peer_live_keys.add(k)
-                if k in my_live or k in my_dead or k in seen:
+                if k in seen:
                     continue
                 seen.add(k)
+                vl = peer_live_v.get(k)
+                if k in my_live:
+                    mv = my_live_v.get(k)
+                    if _versions.compare(vl, mv) > 0:
+                        refresh.append(raw)  # peer strictly newer: replace
+                    elif _versions.compare(mv, vl) > 0:
+                        local_newer += 1  # peer's own sweep pulls OUR row
+                    continue
+                if k in my_dead and not _versions.compare(
+                        vl, my_dead_v.get(k)) > 0:
+                    continue  # our delete outranks (or legacy delete-wins)
                 missing.append(raw)
-            pulled, full = 0, False
+            pulled, refreshed, full = 0, 0, False
             local_only = my_live - peer_live_keys - set(peer_dead)
             extra_dead = my_dead - set(peer_dead)
-            if missing:
+            candidates = missing + refresh
+            if candidates:
                 if (len(missing) > self.cfg.delta_max_rows
-                        and not local_only and not extra_dead):
+                        and not local_only and not extra_dead
+                        and not local_newer and not gated_deletes):
                     self.server.sync_shard_from(index_id, host, port)
                     self._bump("full_syncs")
                     full = True
                 else:
-                    for i in range(0, len(missing), _DELTA_CHUNK):
-                        emb, meta = peer.generic_fun(
-                            "export_rows",
-                            (index_id, missing[i:i + _DELTA_CHUNK]),
-                            timeout=_HEAL_CALL_TIMEOUT_S)
-                        if len(meta):
-                            engine.add_batch(emb, meta)
-                            pulled += len(meta)
+                    def pull(batch):
+                        # rows the peer actually RETURNED (an id deleted
+                        # on the peer between id_sets and this pull
+                        # yields nothing) — the counters report fetched
+                        # rows, missing-pulls and refreshes separately
+                        got = 0
+                        for i in range(0, len(batch), _DELTA_CHUNK):
+                            chunk = batch[i:i + _DELTA_CHUNK]
+                            if peer_versioned:
+                                emb, meta, vers = peer.generic_fun(
+                                    "export_rows_versioned",
+                                    (index_id, chunk),
+                                    timeout=_HEAL_CALL_TIMEOUT_S)
+                            else:
+                                emb, meta = peer.generic_fun(
+                                    "export_rows", (index_id, chunk),
+                                    timeout=_HEAL_CALL_TIMEOUT_S)
+                                vers = None
+                            if len(meta):
+                                engine.add_batch(emb, meta, version=vers)
+                                got += len(meta)
+                        return got
+
+                    pulled = pull(missing)
+                    refreshed = pull(refresh)
                     if pulled:
                         self._bump("rows_repaired", pulled)
+                    if refreshed:
+                        self._bump("rows_refreshed", refreshed)
             if removed or pulled or full:
                 logger.info(
                     "anti-entropy: healed %r from %s:%d (%d deletes "
-                    "applied, %d rows pulled%s)", index_id, host, port,
-                    removed, pulled, ", full sync" if full else "")
-            elif not missing and not local_only and not extra_dead:
+                    "applied, %d rows pulled, %d refreshed%s)", index_id,
+                    host, port, removed, pulled, refreshed,
+                    ", full sync" if full else "")
+            elif (not candidates and not local_only and not extra_dead
+                  and not local_newer and not gated_deletes):
                 # digests mismatched but the id-set delta is EMPTY in BOTH
                 # directions (nothing to pull here, nothing peer-missing
                 # for the peer's own sweep to pull): the divergence is
@@ -441,7 +521,8 @@ class AntiEntropySweeper:
                         "to converge", index_id, host, port)
         finally:
             peer.close()
-        return {"removed": removed, "pulled": pulled, "full_sync": full}
+        return {"removed": removed, "pulled": pulled,
+                "refreshed": refreshed, "full_sync": full}
 
     # ------------------------------------------------------ compaction lease
 

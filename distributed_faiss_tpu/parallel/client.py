@@ -47,9 +47,14 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from distributed_faiss_tpu.mutation import versions as _versions
 from distributed_faiss_tpu.parallel import replication, rpc
 from distributed_faiss_tpu.utils import envutil, lockdep
-from distributed_faiss_tpu.utils.config import IndexCfg, ReplicationCfg
+from distributed_faiss_tpu.utils.config import (
+    IndexCfg,
+    ReplicationCfg,
+    VersioningCfg,
+)
 from distributed_faiss_tpu.utils.state import IndexState
 
 logger = logging.getLogger()
@@ -163,14 +168,21 @@ class IndexClient:
     """Handle to a cluster of index servers (one shard each)."""
 
     # class-level fallbacks: partially-constructed clients (test fixtures
-    # build via object.__new__) degrade to "no suspects, no driver"
+    # build via object.__new__) degrade to "no suspects, no driver,
+    # unversioned writes"
     _suspects: frozenset = frozenset()
     _repair_thread: Optional[threading.Thread] = None
     _repair_stop = threading.Event()
+    _hlc = None
+    vcfg: Optional[VersioningCfg] = None
+    _seeded: frozenset = frozenset()
+    _last_write_version: dict = {}
+    _unversioned_ranks: frozenset = frozenset()
 
     def __init__(self, server_list_path: str, cfg_path: Optional[str] = None,
                  retry_policy: Optional[rpc.RetryPolicy] = None,
-                 replication_cfg: Optional[ReplicationCfg] = None):
+                 replication_cfg: Optional[ReplicationCfg] = None,
+                 versioning_cfg: Optional[VersioningCfg] = None):
         machine_ports = IndexClient.read_server_list(server_list_path)
         self.sub_indexes = IndexClient.setup_connection(machine_ports)
         self.num_indexes = len(self.sub_indexes)
@@ -223,6 +235,19 @@ class IndexClient:
         self._suspects = set()
         self.membership = self._build_membership()
         self._register_groups()
+        # per-id mutation versioning (ISSUE 12): one hybrid logical
+        # clock per client stamps every add/upsert/delete, so the same
+        # logical write carries the SAME version to every replica (and
+        # into every repair re-send — the idempotency key). Seeded per
+        # index from the cluster's watermark on first use, so a client
+        # restarted on a machine whose wall clock went backward still
+        # stamps ahead of its pre-restart writes.
+        self.vcfg = (versioning_cfg if versioning_cfg is not None
+                     else VersioningCfg.from_env())
+        self._hlc = _versions.HLC() if self.vcfg.enabled else None
+        self._seeded = set()            # index_ids whose clock seed ran
+        self._last_write_version = {}   # index_id -> newest stamp (RYW)
+        self._unversioned_ranks = set()  # stubs that rejected `version`
         self.cfg = IndexCfg.from_json(cfg_path) if cfg_path is not None else None
         # opt-in periodic repair driver (DFT_REPAIR_INTERVAL > 0): a
         # named, tracked thread draining the repair queue and refreshing
@@ -492,6 +517,11 @@ class IndexClient:
         groups = sorted(self.membership.snapshot().items())
         if not groups:
             raise RuntimeError("no replica groups registered")
+        # ONE version for the whole logical batch, stamped before any
+        # fan-out: every replica — and every later repair re-send of this
+        # record — carries the same stamp, which is what makes a replica
+        # that already has the batch no-op instead of double-applying
+        version = self._stamp(index_id)
         if index_id not in self.cur_server_ids:
             self.cur_server_ids[index_id] = self._rng.randint(0, len(groups) - 1)
         start = self.cur_server_ids[index_id] % len(groups)
@@ -507,14 +537,17 @@ class IndexClient:
             # to that shard forever
             needed = min(self.quorum, len(reps))
             acked, failed = self._write_group(
-                index_id, reps, embeddings, metadata, train_async_if_triggered)
+                index_id, reps, embeddings, metadata,
+                train_async_if_triggered, version)
             if len(acked) >= needed:
                 if failed:
                     # acked at quorum but not everywhere: the batch is
                     # durable; the missing replicas go to repair
                     self._record_under_replicated(
-                        index_id, gid, failed, embeddings, metadata)
+                        index_id, gid, failed, embeddings, metadata,
+                        version)
                 self.cur_server_ids[index_id] = (gi + 1) % len(groups)
+                self._note_write_acked(index_id, version)
                 return
             if acked:
                 # partial placement below quorum: NOT acknowledged, and
@@ -522,7 +555,7 @@ class IndexClient:
                 # minority replica already holds across shards — record
                 # for repair and raise instead
                 records = self._record_under_replicated(
-                    index_id, gid, failed, embeddings, metadata)
+                    index_id, gid, failed, embeddings, metadata, version)
                 with self._stats_lock:
                     self.counters["quorum_failures"] += 1
                 raise QuorumError(index_id, gid, acked, needed, records)
@@ -552,7 +585,7 @@ class IndexClient:
 
     def _write_group(self, index_id: str, reps: List[int],
                      embeddings: np.ndarray, metadata,
-                     train_async_if_triggered: bool):
+                     train_async_if_triggered: bool, version=None):
         """Fan one batch out to every replica of a group. Returns
         ``(acked positions, [(position, transport error), ...])``; an
         application error from a live replica (ServerException: index not
@@ -561,9 +594,10 @@ class IndexClient:
 
         def one(pos):
             try:
-                self._call_with_retry(
-                    self.sub_indexes[pos], "add_index_data",
+                self._mutation_call(
+                    pos, "add_index_data",
                     (index_id, embeddings, metadata, train_async_if_triggered),
+                    version,
                 )
                 return (pos, None)
             except rpc.TRANSPORT_ERRORS as e:
@@ -575,12 +609,15 @@ class IndexClient:
         return acked, failed
 
     def _record_under_replicated(self, index_id: str, gid: int, failed,
-                                 embeddings, metadata) -> List[dict]:
+                                 embeddings, metadata,
+                                 version=None) -> List[dict]:
         """Log replicas that missed a write into the bounded repair queue
-        (one record per batch, carrying the payload for the re-send)."""
+        (one record per batch, carrying the payload AND the original
+        version for the re-send — the stamp is the idempotency key that
+        lets a replica healed by anti-entropy no-op the re-send)."""
         return self._record_repair_op(
             index_id, gid, failed, op="add",
-            embeddings=embeddings, metadata=metadata)
+            embeddings=embeddings, metadata=metadata, version=version)
 
     def _record_repair_op(self, index_id: str, gid: int, failed,
                           op: str, **payload) -> List[dict]:
@@ -606,16 +643,20 @@ class IndexClient:
         return records
 
     def _repair_send(self, item: dict, pos: int) -> None:
-        """One repair re-send, dispatched by the record's op."""
+        """One repair re-send, dispatched by the record's op — carrying
+        the record's ORIGINAL version, so a replica that already holds
+        the write (healed by anti-entropy, or an ack lost in flight)
+        no-ops it instead of double-applying (the engine's LWW gates;
+        counted in its ``mutation`` perf stats)."""
+        version = item.get("version")
         if item.get("op", "add") == "remove_ids":
-            self._call_with_retry(
-                self.sub_indexes[pos], "remove_ids",
-                (item["index_id"], item["ids"]))
+            self._mutation_call(pos, "remove_ids",
+                                (item["index_id"], item["ids"]), version)
         else:
-            self._call_with_retry(
-                self.sub_indexes[pos], "add_index_data",
-                (item["index_id"], item["embeddings"],
-                 item["metadata"], True))
+            self._mutation_call(
+                pos, "add_index_data",
+                (item["index_id"], item["embeddings"], item["metadata"],
+                 True), version)
 
     def repair_under_replicated(self) -> dict:
         """Background repair: re-send every recorded under-replicated
@@ -696,6 +737,116 @@ class IndexClient:
             self._suspects = set(suspects)
         return suspects
 
+    # ------------------------------------------------------- versioned writes
+
+    def _stamp(self, index_id: str):
+        """One fresh HLC version for a mutation call (None when
+        versioning is off or this client was fixture-built without a
+        clock). First use per index seeds the clock from the cluster's
+        watermark — monotonicity across client restarts even when the
+        machine's wall clock went backward. The stamp becomes the
+        read-your-writes floor only once the write ACKS
+        (``_note_write_acked``) — a totally-failed write must not leave
+        RYW searches demanding a version no replica will ever hold."""
+        if self._hlc is None or self.vcfg is None or not self.vcfg.enabled:
+            return None
+        with self._stats_lock:
+            need_seed = index_id not in self._seeded
+        if need_seed:
+            self._seed_clock(index_id)
+        return self._hlc.tick()
+
+    def _note_write_acked(self, index_id: str, version) -> None:
+        """Record an ACKED mutation's stamp as the index's
+        read-your-writes floor (monotone — fan-out threads may complete
+        out of order)."""
+        if version is None:
+            return
+        with self._stats_lock:
+            cur = self._last_write_version.get(index_id)
+            if _versions.compare(version, cur) > 0:
+                self._last_write_version[index_id] = version
+
+    def _seed_clock(self, index_id: str) -> None:
+        """Observe the max version visible in the cluster: EVERY
+        reachable replica answers ``get_id_sets`` and its ``watermark``
+        (the shard's newest incorporated version) max-merges into the
+        clock. All replicas, not one per group — a write that acked on a
+        quorum minority lives only on SOME replicas, and seeding from a
+        laggard would let a restarted backward-clock client stamp below
+        its own pre-restart writes (which every caught-up replica would
+        then silently no-op). Best-effort: dead or pre-version ranks are
+        skipped — a fresh index simply has nothing to observe."""
+        positions = [p for _g, reps in
+                     sorted(self.membership.snapshot().items())
+                     for p in reps]
+
+        def one(pos):
+            try:
+                return True, self.sub_indexes[pos].generic_fun(
+                    "get_id_sets", (index_id,), timeout=30.0)
+            except rpc.ServerException:
+                # the rank is ALIVE and answered (legacy op set, or the
+                # index does not exist there): a real observation of
+                # "nothing to observe"
+                return True, None
+            except rpc.TRANSPORT_ERRORS:
+                return False, None  # dead rank: its watermark is unknown
+
+        answered = False
+        for ok, sets in self.pool.map(one, positions):
+            answered = answered or ok
+            try:
+                self._hlc.observe((sets or {}).get("watermark"))
+            except (ValueError, TypeError):
+                pass  # garbled watermark from a confused peer
+        if not answered:
+            # a transient total outage must not latch "seeded": an
+            # un-reseeded backward-clock restart would stamp below its
+            # own pre-restart writes and every caught-up replica would
+            # silently no-op the session's mutations — retry the seed on
+            # the next mutation instead
+            logger.warning(
+                "HLC seed for %r reached no rank; will retry on the next "
+                "mutation", index_id)
+            return
+        with self._stats_lock:
+            self._seeded.add(index_id)
+
+    def _mutation_call(self, pos: int, fname: str, args, version):
+        """One replica's mutation RPC with the version stamped in —
+        degrading gracefully against PRE-VERSION servers: a rank that
+        rejects the ``version`` keyword (TypeError surfaced as
+        ServerException) is retried without it and remembered, so a
+        rolling upgrade never wedges ingest (the un-versioned replica
+        converges through anti-entropy like any legacy peer)."""
+        stub = self.sub_indexes[pos]
+        with self._stats_lock:
+            legacy = pos in self._unversioned_ranks
+        if version is not None and not legacy:
+            try:
+                return self._call_with_retry(stub, fname, args,
+                                             {"version": version})
+            except rpc.ServerException as e:
+                if not ("unexpected keyword argument" in str(e)
+                        and "version" in str(e)):
+                    raise
+                logger.warning(
+                    "rank %s (%s:%s) does not speak mutation versions; "
+                    "degrading its writes to un-versioned (upgrade the "
+                    "rank to restore LWW reconciliation there)",
+                    stub.id, stub.host, stub.port)
+                with self._stats_lock:
+                    self._unversioned_ranks.add(pos)
+        return self._call_with_retry(stub, fname, args)
+
+    def last_write_version(self, index_id: str):
+        """The newest version this client stamped onto ``index_id`` —
+        what ``search(read_your_writes=True)`` demands replicas have
+        incorporated. None before any versioned write from this client."""
+        with self._stats_lock:
+            return self._last_write_version.get(index_id)
+
     # ------------------------------------------------------------- mutation
 
     def remove_ids(self, index_id: str, ids) -> int:
@@ -726,11 +877,15 @@ class IndexClient:
         groups = sorted(self.membership.snapshot().items())
         if not groups:
             raise RuntimeError("no replica groups registered")
+        # one version for the whole delete: replicas (and repair
+        # re-sends) all see the same stamp — an upsert stamped later
+        # outranks it everywhere, however the fan-outs interleave
+        version = self._stamp(index_id)
 
         def one(pos):
             try:
-                return pos, self._call_with_retry(
-                    self.sub_indexes[pos], "remove_ids", (index_id, ids))
+                return pos, self._mutation_call(
+                    pos, "remove_ids", (index_id, ids), version)
             except rpc.TRANSPORT_ERRORS as e:
                 return pos, e
 
@@ -749,13 +904,15 @@ class IndexClient:
                 if failed:
                     # durable at quorum; the missed replicas go to repair
                     self._record_repair_op(index_id, gid, failed,
-                                           op="remove_ids", ids=ids)
+                                           op="remove_ids", ids=ids,
+                                           version=version)
                 continue
             # below quorum: record for repair, never reroute cross-group;
             # keep attempting the remaining groups (their rows must still
             # be deleted) and raise the structured failure at the end
             records = self._record_repair_op(index_id, gid, failed,
-                                             op="remove_ids", ids=ids)
+                                             op="remove_ids", ids=ids,
+                                             version=version)
             with self._stats_lock:
                 self.counters["quorum_failures"] += 1
             if quorum_failure is None:
@@ -763,6 +920,7 @@ class IndexClient:
                     index_id, gid, [p for p, _r in acked], needed, records)
         if quorum_failure is not None:
             raise quorum_failure
+        self._note_write_acked(index_id, version)
         return removed
 
     def upsert(self, index_id: str, ids, embeddings: np.ndarray,
@@ -828,6 +986,8 @@ class IndexClient:
         allow_partial: bool = False,
         partial_timeout: Optional[float] = None,
         deadline: Optional[float] = None,
+        min_version=None,
+        read_your_writes: bool = False,
     ) -> tuple:  # (D, meta[, embs][, missing]) — see docstring
         """Fan-out search with client-side top-k merge.
 
@@ -866,8 +1026,23 @@ class IndexClient:
         retry budget is reported in ``missing`` (with its BusyError) and
         the merge proceeds without it; transport failures keep their
         single-attempt degrade-fast semantics.
+
+        Consistency (ISSUE 12): ``read_your_writes=True`` demands every
+        shard reflect this client's own last versioned mutation — each
+        per-rank RPC carries ``min_version`` (explicitly passable too,
+        e.g. a version handed over from another client) and a replica
+        whose watermark is behind it rejects with the structured
+        stale-read error, which fails over to a group peer that HAS
+        incorporated the write (the write acked at quorum, so one
+        exists); only a whole group behind the version raises. Requires
+        version-aware servers — a pre-version rank rejects the unknown
+        argument like any bad-args application error.
         """
         q_size = query.shape[0]
+        if read_your_writes:
+            own = self.last_write_version(index_id)
+            if min_version is None or _versions.compare(own, min_version) > 0:
+                min_version = own
         if self.cfg is None:
             # without the metric we cannot merge correctly (dot needs
             # negation); fail loudly instead of silently min-merging
@@ -892,6 +1067,9 @@ class IndexClient:
         if not plan:
             raise RuntimeError("no replica groups registered")
 
+        search_kwargs = ({"min_version": min_version}
+                         if min_version is not None else None)
+
         def call_stub(idx, timeout=None):
             # BUSY (and only BUSY) retries in place: transport errors keep
             # their degrade-fast semantics (failover to the next replica,
@@ -900,7 +1078,7 @@ class IndexClient:
             return self.retry.run_filtered(
                 (rpc.BusyError,), abs_deadline, idx.generic_fun,
                 "search", (index_id, query, topk, return_embeddings),
-                None, timeout=timeout, deadline=abs_deadline,
+                search_kwargs, timeout=timeout, deadline=abs_deadline,
             )
 
         def note_failover(group, pos):
@@ -928,17 +1106,21 @@ class IndexClient:
                         last = e
                         continue
                     except rpc.ServerException as e:
-                        # ONE application error is failover-eligible: the
-                        # engine's transient mid-ADD (buffer drain)
+                        # TWO application errors are failover-eligible:
+                        # the engine's transient mid-ADD (buffer drain)
                         # rejection — the group keeps serving from a peer
-                        # while a replica drains. Every other application
-                        # error (and a whole group mid-drain) still raises.
-                        if (replication.drain_failover_eligible(e)
+                        # while a replica drains — and the stale-read
+                        # rejection of a min_version (read-your-writes)
+                        # demand, where the quorum guarantees a caught-up
+                        # peer exists. Every other application error (and
+                        # a whole group drained/stale) still raises.
+                        if ((replication.drain_failover_eligible(e)
+                             or replication.stale_read_failover_eligible(e))
                                 and i + 1 < len(ordering)):
                             logger.info(
-                                "replica %s of group %s is draining its add "
-                                "buffer; failing search over to a peer",
-                                idx.id, group)
+                                "replica %s of group %s cannot serve this "
+                                "search yet (%s); failing over to a peer",
+                                idx.id, group, e)
                             last = e
                             continue
                         raise
@@ -981,11 +1163,13 @@ class IndexClient:
                     fails.append(_FailedRank(idx, e))
                     continue
                 except rpc.ServerException as e:
-                    # mid-ADD drain rejection: group-failover-eligible
-                    # (see one_strict); a whole group mid-drain — or any
+                    # mid-ADD drain / stale-read rejections: group-
+                    # failover-eligible (see one_strict); a whole group
+                    # drained or behind the demanded version — or any
                     # other application error — still raises rather than
                     # silently dropping a healthy shard's corpus
-                    if (replication.drain_failover_eligible(e)
+                    if ((replication.drain_failover_eligible(e)
+                         or replication.stale_read_failover_eligible(e))
                             and i + 1 < len(ordering)):
                         fails.append(_FailedRank(idx, e))
                         continue
@@ -1113,6 +1297,93 @@ class IndexClient:
             )
         return new_scores, new_meta
 
+    # ------------------------------------------------ generation-pinned reads
+
+    def pin_generations(self, index_id: str) -> dict:
+        """Snapshot each reachable replica's newest committed generation:
+        ``{stub position: generation}`` (positions with nothing committed
+        or unreachable/pre-version ranks are omitted). The pin set is the
+        point-in-time handle — take it BEFORE a mutation burst, pass it
+        to ``search_at_generation`` afterwards, and the results reflect
+        exactly the pinned commit on every shard."""
+        positions = [p for _g, reps in
+                     sorted(self.membership.snapshot().items())
+                     for p in reps]
+
+        def one(pos):
+            try:
+                gen = self._call_with_retry(
+                    self.sub_indexes[pos], "get_generation", (index_id,))
+            except rpc.TRANSPORT_ERRORS + (rpc.ServerException,):
+                return pos, None  # dead/legacy rank: no pin
+            return pos, (int(gen) if gen else None)
+
+        return {pos: gen
+                for pos, gen in self.pool.map(one, positions)
+                if gen is not None}
+
+    def search_at_generation(self, query: np.ndarray, topk: int,
+                             index_id: str, pins: Optional[dict] = None
+                             ) -> tuple:
+        """Point-in-time fan-out search: every shard serves the committed
+        generation pinned for it in ``pins`` (``pin_generations`` output;
+        fetched fresh when None — i.e. "the newest commit as of now"),
+        regardless of any mutation since. Per group the walk tries each
+        PINNED replica in the usual failover order; transport failures
+        and a replica that has pruned its pinned generation (application
+        error) both fail over, and only a group with no pinned serving
+        replica raises. Merge semantics match ``search``. Returns
+        ``(D, meta)``."""
+        query = np.asarray(query, np.float32)
+        q_size = query.shape[0]
+        if self.cfg is None:
+            raise RuntimeError(
+                "IndexClient has no cfg for this index: pass cfg_path at "
+                "construction, or call create_index/load_index first"
+            )
+        if pins is None:
+            pins = self.pin_generations(index_id)
+        maximize_metric = self.cfg.metric == "dot"
+        with self._stats_lock:
+            preferred = dict(self._preferred)
+            suspects = frozenset(self._suspects)
+        plan = replication.plan_read_fanout(self.membership, preferred,
+                                            suspects)
+        if not plan:
+            raise RuntimeError("no replica groups registered")
+
+        def one_group(item):
+            group, _pick, ordering = item
+            pinned = [p for p in ordering if p in pins]
+            if not pinned:
+                raise RuntimeError(
+                    f"group {group} has no replica with a pinned "
+                    f"committed generation for {index_id!r}")
+            last = None
+            for pos in pinned:
+                idx = self.sub_indexes[pos]
+                try:
+                    return idx.generic_fun(
+                        "search_at_generation",
+                        (index_id, query, topk, pins[pos]))
+                except rpc.TRANSPORT_ERRORS + (rpc.BusyError,) as e:
+                    last = e
+                    continue
+                except rpc.ServerException as e:
+                    # pinned generation pruned/never committed on this
+                    # replica: another replica's own pin may still serve
+                    logger.warning(
+                        "replica %s of group %s cannot serve its pinned "
+                        "generation: %s", idx.id, group, e)
+                    last = e
+                    continue
+            raise last
+
+        results = [(d, m, e) for d, m, e
+                   in self.pool.map(one_group, plan)]
+        return IndexClient._aggregate_results(
+            iter(results), topk, q_size, maximize_metric, False)
+
     # ------------------------------------------------------------ observability
 
     def get_state(self, index_id: str) -> IndexState:
@@ -1218,6 +1489,7 @@ class IndexClient:
             counters = dict(self.counters)
             recent = len(self.reroutes)
             suspects = sorted(self._suspects)
+            unversioned = sorted(self._unversioned_ranks)
         repair = self.repair_queue.stats()
         return {
             "counters": counters,
@@ -1229,6 +1501,15 @@ class IndexClient:
             "repair": repair,
             "degraded": repair["dropped"] > 0,
             "suspects": suspects,
+            "versioning": {
+                "enabled": bool(self._hlc is not None and self.vcfg is not None
+                                and self.vcfg.enabled),
+                "writer_id": (self._hlc.writer_id
+                              if self._hlc is not None else None),
+                # pre-version ranks this client degraded to un-versioned
+                # writes against (rolling-upgrade visibility)
+                "unversioned_ranks": unversioned,
+            },
         }
 
     def ping(self, timeout: float = 10.0) -> list:

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 from distributed_faiss_tpu.utils import lockdep
 from distributed_faiss_tpu.utils.state import (
     NOT_TRAINED_REJECTION_FMT,
+    STALE_READ_REJECTION_PREFIX,
     IndexState,
 )
 
@@ -104,6 +105,20 @@ def drain_failover_eligible(exc: BaseException) -> bool:
 
     return (isinstance(exc, rpc.ServerException)
             and _DRAIN_REJECTION in str(exc))
+
+
+def stale_read_failover_eligible(exc: BaseException) -> bool:
+    """True when a replica rejected a ``min_version`` (read-your-writes)
+    search because its applied-mutation watermark is still behind the
+    demanded version (engine.assert_min_version). Like the mid-ADD drain
+    rejection this is group-failover-eligible: the write acked at quorum,
+    so SOME replica of the group has applied it — walk to that one
+    instead of surfacing the laggard's rejection. Every other application
+    error still raises (it would repeat identically on every replica)."""
+    from distributed_faiss_tpu.parallel import rpc
+
+    return (isinstance(exc, rpc.ServerException)
+            and STALE_READ_REJECTION_PREFIX in str(exc))
 
 
 def quorum_size(replication: int, write_quorum: int = 0) -> int:

@@ -173,12 +173,20 @@ class IndexServer:
         embeddings: np.ndarray,
         metadata=None,
         train_async_if_triggered: bool = True,
+        version=None,
     ) -> None:
-        self._get_index(index_id).add_batch(embeddings, metadata, train_async_if_triggered)
+        self._get_index(index_id).add_batch(
+            embeddings, metadata, train_async_if_triggered, version=version)
 
     def search(self, index_id: str, query_batch: np.ndarray, top_k: int,
-               return_embeddings: bool = False) -> Tuple:
-        return self._get_index(index_id).search(
+               return_embeddings: bool = False, min_version=None) -> Tuple:
+        index = self._get_index(index_id)
+        if min_version is not None:
+            # read-your-writes gate: reject BEFORE the device if this
+            # replica has not incorporated the demanded version (the
+            # structured rejection is group-failover-eligible client-side)
+            index.assert_min_version(min_version)
+        return index.search(
             query_batch, top_k=top_k, return_embeddings=return_embeddings
         )
 
@@ -193,19 +201,23 @@ class IndexServer:
 
     # ------------------------------------------------------------- mutation
 
-    def remove_ids(self, index_id: str, ids) -> int:
+    def remove_ids(self, index_id: str, ids, version=None) -> int:
         """Tombstone rows by metadata id (mutation subsystem): masked on
         device immediately, persisted to the sidecar before the ack —
         a crash after this returns can never resurrect the rows. One of
         the new wire ops; like every op it rides both serving loops
-        (mux worker-pool dispatch and the legacy sync path)."""
-        return self._get_index(index_id).remove_ids(ids)
+        (mux worker-pool dispatch and the legacy sync path). ``version``
+        (an HLC stamp from the client) makes the delete LWW-gated and
+        replay-idempotent — engine.remove_ids."""
+        return self._get_index(index_id).remove_ids(ids, version=version)
 
-    def upsert(self, index_id: str, ids, embeddings, metadata=None) -> int:
+    def upsert(self, index_id: str, ids, embeddings, metadata=None,
+               version=None) -> int:
         """Delete + add under one op: the ids' live rows stop serving
         before the ack; replacements ingest through the normal buffered
         add path (visible when their chunk drains, like any add)."""
-        return self._get_index(index_id).upsert(ids, embeddings, metadata)
+        return self._get_index(index_id).upsert(ids, embeddings, metadata,
+                                                version=version)
 
     def compact_index(self, index_id: str) -> bool:
         """Operator-triggered compaction pass (the background watcher
@@ -403,14 +415,43 @@ class IndexServer:
 
     def get_id_sets(self, index_id: str) -> dict:
         """Anti-entropy delta protocol: this shard's normalized live-id
-        set and deletion ledger (engine.id_sets)."""
+        set and deletion ledger (engine.id_sets), with the per-id version
+        planes and the shard watermark since ISSUE 12 (a pre-version
+        caller just ignores the extra keys)."""
         return self._get_index(index_id).id_sets()
 
     def export_rows(self, index_id: str, ids) -> Tuple:
         """Anti-entropy delta protocol: (embeddings, metadata) for the
         requested live ids (engine.export_rows) — the pull side of a
-        peer's delta repair."""
+        peer's delta repair. The pre-version 2-tuple wire shape."""
         return self._get_index(index_id).export_rows(ids)
+
+    def export_rows_versioned(self, index_id: str, ids) -> Tuple:
+        """Versioned delta pull: (embeddings, metadata, versions) — the
+        puller applies rows through the engine's LWW add gates. A
+        separate op (not a changed return shape) so pre-version sweepers
+        calling ``export_rows`` keep working unchanged."""
+        return self._get_index(index_id).export_rows_versioned(ids)
+
+    # --------------------------------------------------- generation-pinned reads
+
+    def get_generation(self, index_id: str) -> int:
+        """Newest committed snapshot generation of this rank's shard
+        (0 = nothing committed) — what a client pins for point-in-time
+        reads (IndexClient.pin_generations)."""
+        return self._get_index(index_id).current_generation()
+
+    def search_at_generation(self, index_id: str, query_batch: np.ndarray,
+                             top_k: int, generation: int,
+                             return_embeddings: bool = False) -> Tuple:
+        """Point-in-time search against a retained committed generation
+        (engine.search_at_generation). Deliberately NOT routed through
+        the serving scheduler: pinned reads are a cold consistency path
+        and must not share jit buckets or merge windows with live
+        traffic."""
+        return self._get_index(index_id).search_at_generation(
+            query_batch, top_k=top_k, generation=generation,
+            return_embeddings=return_embeddings)
 
     def _serve_digest(self, conn: socket.socket, payload,
                       wlock: Optional[threading.Lock] = None) -> None:
@@ -792,10 +833,21 @@ class IndexServer:
         vals = dict(zip(
             ("index_id", "query_batch", "top_k", "return_embeddings"), args))
         vals.update(kwargs or {})
+        self._check_search_min_version(vals)
         return self.scheduler.submit(
             vals["index_id"], vals["query_batch"], vals["top_k"],
             bool(vals.get("return_embeddings", False)), deadline=deadline,
             eager=eager)
+
+    def _check_search_min_version(self, vals: dict) -> None:
+        """Pop a search's ``min_version`` (read-your-writes) demand and
+        assert it BEFORE the scheduler sees the request: the watermark
+        check needs no device and must not occupy a merge window, and
+        the stale-read rejection must stay a plain application error
+        (group-failover-eligible client-side) on both serving paths."""
+        min_version = vals.pop("min_version", None)
+        if min_version is not None:
+            self._get_index(vals["index_id"]).assert_min_version(min_version)
 
     # ------------------------------------------------------------ mux dispatch
 
@@ -824,6 +876,7 @@ class IndexServer:
                 ("index_id", "query_batch", "top_k", "return_embeddings"),
                 args))
             vals.update(kwargs or {})
+            self._check_search_min_version(vals)
             self.scheduler.submit_async(
                 vals["index_id"], vals["query_batch"], vals["top_k"],
                 bool(vals.get("return_embeddings", False)),

@@ -17,6 +17,20 @@ from typing import List
 # draining its add buffer" and group-failover-eligible.
 NOT_TRAINED_REJECTION_FMT = "Server index is not trained. state: {state}"
 
+# The engine's read-your-writes rejection (engine.assert_min_version):
+# raised when a search demands ``min_version`` consistency but this
+# replica's applied-mutation watermark is still behind it (the write
+# landed on a quorum that did not include this replica; repair or the
+# anti-entropy sweep will catch it up). The PREFIX is the stable matcher
+# key — the replicated read path fails such a search over to a group
+# peer that HAS applied the write (parallel/replication.py
+# stale_read_failover_eligible) exactly like the mid-ADD drain window,
+# and sharing the constant keeps a reword from silently disabling that
+# failover.
+STALE_READ_REJECTION_PREFIX = "Server replica has not applied version"
+STALE_READ_REJECTION_FMT = (
+    STALE_READ_REJECTION_PREFIX + " {version} (watermark: {watermark})")
+
 
 class IndexState(Enum):
     NOT_TRAINED = 1

@@ -690,7 +690,8 @@ def test_one_directional_divergence_stays_quiet(tmp_path, caplog):
             out = a._antientropy.sweep_once()
         healed = [h for h in out["healed"] if h["index_id"] == "t"]
         assert healed == [{"index_id": "t", "peer": ("localhost", pb),
-                           "removed": 0, "pulled": 0, "full_sync": False}]
+                           "removed": 0, "pulled": 0, "refreshed": 0,
+                           "full_sync": False}]
         assert a._antientropy.stats()["empty_deltas"] == 0
         assert not any("id-set delta is empty" in r.message
                        for r in caplog.records)
@@ -736,7 +737,8 @@ def test_empty_delta_mismatch_counts_and_warns(tmp_path, caplog):
             out = b._antientropy.sweep_once()
         healed = [h for h in out["healed"] if h["index_id"] == "t"]
         assert healed == [{"index_id": "t", "peer": ("localhost", pa),
-                           "removed": 0, "pulled": 0, "full_sync": False}]
+                           "removed": 0, "pulled": 0, "refreshed": 0,
+                           "full_sync": False}]
         assert b._antientropy.stats()["empty_deltas"] == 1
         assert any("id-set delta is empty" in r.message
                    for r in caplog.records)

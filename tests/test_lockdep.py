@@ -247,13 +247,16 @@ def test_engine_locks_are_instrumented(witness):
 
 @pytest.mark.slow
 def test_scheduler_and_rpcmux_suites_under_witness():
-    """The lockdep-tier satellite: re-run the scheduler + rpc-mux suites
-    with DFT_LOCKDEP=1 — every pinned lock in the serving path runs
-    instrumented, so a dynamic lock-order inversion fails the suite."""
+    """The lockdep-tier satellite: re-run the scheduler + rpc-mux +
+    versions suites with DFT_LOCKDEP=1 — every pinned lock in the
+    serving path (including the new version-watermark / pinned-snapshot
+    / HLC locks) runs instrumented, so a dynamic lock-order inversion
+    fails the suite."""
     env = dict(os.environ, DFT_LOCKDEP="1", JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/test_scheduler.py",
          "tests/test_scheduler_identity.py", "tests/test_rpc_mux.py",
+         "tests/test_versions.py",
          "-q", "-m", "not slow", "-p", "no:cacheprovider"],
         env=env, capture_output=True, text=True, timeout=1200,
     )

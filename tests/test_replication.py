@@ -566,6 +566,78 @@ def test_shard_transfer_over_the_wire(tmp_path):
         b.stop()
 
 
+def test_repair_resend_is_versioned_noop_on_healed_replica(tmp_path):
+    """ISSUE 12 satellite regression: ``repair_under_replicated()``
+    re-sends used to DOUBLE-APPLY on a replica that already healed via
+    anti-entropy. With per-id versions the re-send carries the batch's
+    original stamp and the healed replica's LWW add gate no-ops it —
+    over a real loopback server, ntotal and the digest stay put and the
+    engine counts the no-op."""
+    import socket
+    import time
+
+    from distributed_faiss_tpu.parallel.server import IndexServer
+    from distributed_faiss_tpu.utils.config import VersioningCfg
+    from distributed_faiss_tpu.utils.state import IndexState
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    port = free_port()
+    srv = IndexServer(0, str(tmp_path / "a"))
+    threading.Thread(target=srv.start_blocking, args=(port,),
+                     daemon=True).start()
+    time.sleep(0.3)
+    stub = rpc.Client(0, "localhost", port)
+    client = make_client([stub])
+    client.vcfg = VersioningCfg()
+    from distributed_faiss_tpu.mutation.versions import HLC
+
+    client._hlc = HLC(writer_id=5)
+    client._seeded = {"t"}
+    client._last_write_version = {}
+    client._unversioned_ranks = set()
+    try:
+        cfg = IndexCfg(index_builder_type="flat", dim=8, metric="l2",
+                       train_num=10)
+        stub.generic_fun("create_index", ("t", cfg))
+        x = np.random.default_rng(0).standard_normal((30, 8)).astype(
+            np.float32)
+        meta = [(i,) for i in range(30)]
+        client.cur_server_ids["t"] = 0
+        client.add_index_data("t", x, meta)
+        deadline = time.time() + 60
+        while not (stub.generic_fun("get_state", ("t",))
+                   == IndexState.TRAINED
+                   and stub.generic_fun("get_aggregated_ntotal",
+                                        ("t",)) == 0):
+            assert time.time() < deadline
+            time.sleep(0.05)
+        assert stub.generic_fun("get_ntotal", ("t",)) == 30
+        # fabricate the outage's repair record: the SAME batch, the SAME
+        # version, re-sent to a replica that (here: trivially) already
+        # holds it — the pre-version behavior appended 30 duplicate rows
+        client.repair_queue.record({
+            "op": "add", "index_id": "t", "group": 0, "missing": [0],
+            "failures": [], "embeddings": x, "metadata": meta,
+            "version": client.last_write_version("t"),
+        })
+        out = client.repair_under_replicated()
+        assert out == {"repaired": 1, "still_pending": 0}
+        time.sleep(0.3)
+        assert stub.generic_fun("get_ntotal", ("t",)) == 30
+        assert stub.generic_fun("get_aggregated_ntotal", ("t",)) == 0
+        mut = stub.generic_fun("get_perf_stats")["mutation"]["t"]
+        assert mut["version_noop_adds"] == 30, mut
+    finally:
+        stub.close()
+        srv.stop()
+
+
 def test_mark_rank_left_removes_from_rotation():
     a, b = FakeStub(0, score=3.0), FakeStub(1, score=3.0)
     client = search_client([a, b], rcfg=ReplicationCfg(replication=2))

@@ -234,15 +234,16 @@ def test_e2e_cases_skip_without_driver_env(monkeypatch):
 @pytest.mark.slow
 def test_threaded_suites_under_witness():
     """The threadcheck-tier satellite (mirrors the lockdep tier): re-run
-    the scheduler, replication, anti-entropy, and mutation fast suites
-    with DFT_THREADCHECK=1 — every test that starts a non-daemon thread
-    and does not join it fails with the thread's creation site."""
+    the scheduler, replication, anti-entropy, mutation, and versions
+    fast suites with DFT_THREADCHECK=1 — every test that starts a
+    non-daemon thread and does not join it fails with the thread's
+    creation site."""
     env = dict(os.environ, DFT_THREADCHECK="1", JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, "-m", "pytest",
          "tests/test_scheduler.py", "tests/test_scheduler_identity.py",
          "tests/test_replication.py", "tests/test_mutation.py",
-         "tests/test_antientropy.py",
+         "tests/test_antientropy.py", "tests/test_versions.py",
          "-q", "-m", "not slow", "-p", "no:cacheprovider"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=2400)
     assert proc.returncode == 0, (

@@ -113,6 +113,30 @@ PINS = {
     ("AntiEntropySweeper", "_last_empty_warn"): "_lock",
     ("IndexClient", "_suspects"): "_stats_lock",
     ("RepairQueue", "_last_drop_warn"): "_lock",
+    # per-id mutation versioning (ISSUE 12, mutation/versions.py +
+    # engine/client wiring): the engine's per-writer watermark dict rides
+    # index_lock with the rest of the version state (per-id versions live
+    # inside the TombstoneSet under the same lock); the pinned-generation
+    # snapshot cache has its own leaf lock so point-in-time reads never
+    # contend with the serving locks; the client's HLC bookkeeping
+    # (clock-seeded index set, last-stamp map for read-your-writes, the
+    # legacy-rank degrade set) is shared between user threads and the
+    # fan-out pool under the stats lock; the HLC's own physical/logical
+    # counters between every stamping thread
+    ("Index", "_version_watermark"): "index_lock",
+    ("Index", "_saved_tombstone_version"): "index_lock",
+    # the snapshot-generation counter is written under BOTH engine locks
+    # (save/compact hold them together), so majority inference flaps
+    # between the two on set order — pin the read side's lock: the
+    # pinned-read path (current_generation) snapshots it under
+    # index_lock alone
+    ("Index", "_generation"): "index_lock",
+    ("Index", "_pinned_cache"): "_pinned_lock",
+    ("IndexClient", "_seeded"): "_stats_lock",
+    ("IndexClient", "_last_write_version"): "_stats_lock",
+    ("IndexClient", "_unversioned_ranks"): "_stats_lock",
+    ("HLC", "_last_ms"): "_lock",
+    ("HLC", "_counter"): "_lock",
 }
 
 # the modules the pinned classes live in: the frame-protocol stale-pin
@@ -127,6 +151,7 @@ PIN_HOMES = (
     "parallel/client.py",
     "parallel/replication.py",
     "parallel/antientropy.py",
+    "mutation/versions.py",
     "testing/chaos.py",
 )
 
