@@ -38,6 +38,7 @@ _LAZY = {
     "ReplicationCfg": ("distributed_faiss_tpu.utils.config", "ReplicationCfg"),
     "AntiEntropyCfg": ("distributed_faiss_tpu.utils.config", "AntiEntropyCfg"),
     "VersioningCfg": ("distributed_faiss_tpu.utils.config", "VersioningCfg"),
+    "TracingCfg": ("distributed_faiss_tpu.utils.config", "TracingCfg"),
     "HLC": ("distributed_faiss_tpu.mutation.versions", "HLC"),
     "QuorumError": ("distributed_faiss_tpu.parallel.client", "QuorumError"),
     "MembershipTable": ("distributed_faiss_tpu.parallel.replication",

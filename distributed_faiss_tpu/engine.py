@@ -36,6 +36,7 @@ from distributed_faiss_tpu.models.factory import (
     remove_rows_unsupported,
 )
 from distributed_faiss_tpu.mutation import compaction as _compaction
+from distributed_faiss_tpu.observability import spans as obs_spans
 from distributed_faiss_tpu.mutation import tombstones as _tombstones
 from distributed_faiss_tpu.mutation import versions as _versions
 from distributed_faiss_tpu.mutation.tombstones import TombstoneSet
@@ -287,6 +288,11 @@ class Index:
         # wire round-trip (client rpc stats), queue wait (scheduler), and
         # device time side by side when tuning pipelining depth
         self.perf = LatencyStats()
+        # distributed-tracing span ring (observability/spans.py): the
+        # owning server wires its SpanBuffer in (_wire_engine) so a
+        # sampled launch records an ``engine.launch`` span; standalone
+        # engines stay None and record nothing
+        self.span_buffer = None
         # newest committed snapshot generation in this shard's storage dir
         # (0 = nothing committed yet; from_storage_dir seeds it on restore)
         self._generation = 0
@@ -1459,17 +1465,30 @@ class Index:
             if self.state != IndexState.TRAINED:
                 raise RuntimeError(
                     NOT_TRAINED_REJECTION_FMT.format(state=self.state))
+            # sampled-trace handoff from the scheduler's batcher thread
+            # (observability/spans.py): one TLS read when a buffer is
+            # wired, nothing at all otherwise
+            trace_id = (obs_spans.current_trace()
+                        if self.span_buffer is not None else None)
             launches0 = getattr(self.tpu_index, "launches", None)
+            w0 = time.time() if trace_id is not None else 0.0
             t0 = time.perf_counter()
             out = self.tpu_index.search_batched(query_batch, top_k)
-            self.perf.record("device_search_s", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.perf.record("device_search_s", dt, exemplar=trace_id)
             self.perf.record("device_search_rows", float(query_batch.shape[0]))
+            launches = None
             if launches0 is not None:
                 launches = self.tpu_index.launches - launches0
                 self.perf.record("device_launches", float(launches))
                 if launches > 0:
                     self.perf.record(
                         "rows_per_launch", query_batch.shape[0] / launches)
+            if trace_id is not None:
+                self.span_buffer.record(
+                    trace_id, "engine.launch", w0, dt,
+                    rows=int(query_batch.shape[0]),
+                    launches=None if launches is None else int(launches))
             return out
 
     def _run_and_join(self, run, return_embeddings: bool):
@@ -1667,7 +1686,7 @@ class Index:
             embs = [[embs_arr[i, j] for j in range(k)] for i in range(nq)]
         return scores, results_meta, embs
 
-    def perf_stats(self) -> dict:
+    def perf_stats(self, raw: bool = False) -> dict:
         """Per-index device-launch latency summary: ``device_search_s``
         (wall time of each locked launch), ``device_search_rows`` (rows per
         merged window — the "_s" suffix on summary keys is historical;
@@ -1676,8 +1695,9 @@ class Index:
         ``device_launches`` (device dispatches per merged window — the
         one-launch serving contract means max_s == 1.0) and
         ``rows_per_launch`` (window occupancy per dispatch). Served
-        through IndexServer.get_perf_stats under ``"engine"``."""
-        return self.perf.summary()
+        through IndexServer.get_perf_stats under ``"engine"``; ``raw``
+        adds the bucket histograms (the Prometheus exporter's view)."""
+        return self.perf.summary(raw=raw)
 
     def get_centroids(self):
         with self.index_lock:

@@ -1,0 +1,165 @@
+"""Prometheus text-exposition export of the perf-stats tree.
+
+``render_prometheus`` walks the nested dict ``get_perf_stats`` (and its
+per-subsystem blocks) already produce and renders the 0.0.4
+text-exposition format:
+
+- a ``LatencyStats`` op summary carrying its raw histogram (``hist``,
+  from ``summary(raw=True)``) becomes a real Prometheus **histogram**:
+  cumulative ``_bucket{le="..."}`` series over the REAL log-spaced
+  bounds (utils/tracing.bucket_bounds), plus ``_sum``/``_count`` — so
+  PromQL's ``histogram_quantile`` computes the same percentiles the
+  in-repo summaries report;
+- every other numeric leaf becomes a **gauge** named by its sanitized
+  path (``dft_scheduler_counters_shed_deadline``);
+- strings/None/containers that aren't stats are skipped (identity rows
+  like ``shard_group`` export as gauges only when numeric).
+
+``MetricsExporter`` is the optional per-rank HTTP listener behind
+``DFT_METRICS_PORT`` (0 = off, the default): a single-threaded
+``http.server`` answering ``GET /metrics`` — scrapes are one bounded
+render, and a sequential handler means no per-request thread spawn to
+leak or name. The listener thread is named, tracked, and joined in
+``stop()`` (the thread-lifecycle contract, docs/LINTING.md).
+"""
+
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Callable, Optional
+
+from distributed_faiss_tpu.utils.tracing import bucket_bounds
+
+logger = logging.getLogger()
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize(part: str) -> str:
+    return _NAME_RE.sub("_", str(part))
+
+
+def _labels_text(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{_sanitize(k)}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _is_hist_summary(value) -> bool:
+    return (isinstance(value, dict) and isinstance(value.get("hist"), list)
+            and "count" in value and "total_s" in value)
+
+
+def _render_histogram(lines, name, value, labels) -> None:
+    lines.append(f"# TYPE {name} histogram")
+    cum = 0
+    for i, n in enumerate(value["hist"]):
+        cum += n
+        le = f"{bucket_bounds()[i]:.6g}"
+        lab = _labels_text({**labels, "le": le})
+        lines.append(f"{name}_bucket{lab} {cum}")
+    lab = _labels_text({**labels, "le": "+Inf"})
+    lines.append(f"{name}_bucket{lab} {value['count']}")
+    lines.append(f"{name}_sum{_labels_text(labels)} {value['total_s']:.9g}")
+    lines.append(f"{name}_count{_labels_text(labels)} {value['count']}")
+
+
+def render_prometheus(stats: dict, prefix: str = "dft",
+                      labels: Optional[dict] = None) -> str:
+    """Render a perf-stats tree to Prometheus text exposition. ``labels``
+    (e.g. ``{"rank": 0}``) are stamped onto every series."""
+    labels = {k: str(v) for k, v in (labels or {}).items()}
+    lines = []
+
+    def walk(path, value):
+        if _is_hist_summary(value):
+            _render_histogram(
+                lines, prefix + "_" + "_".join(_sanitize(p) for p in path),
+                value, labels)
+            return
+        if isinstance(value, dict):
+            for k, v in value.items():
+                # the raw-summary side channels ride inside hist
+                # summaries (handled above); stray ones are not metrics
+                if k in ("exemplars", "hist", "p99_exemplar"):
+                    continue
+                walk(path + (k,), v)
+            return
+        if isinstance(value, bool):
+            value = int(value)
+        if isinstance(value, (int, float)):
+            name = prefix + "_" + "_".join(_sanitize(p) for p in path)
+            lines.append(f"{name}{_labels_text(labels)} {value:.9g}")
+
+    walk((), stats)
+    return "\n".join(lines) + "\n"
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    # the exporter installs itself on the server object (self.server)
+
+    # per-CONNECTION socket timeout (StreamRequestHandler.setup applies it
+    # via settimeout): the listener is sequential, so a scraper that
+    # connects and sends nothing must be dropped after this long instead
+    # of wedging every subsequent scrape — and stop() — forever
+    timeout = 5.0
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        if self.path.split("?", 1)[0] != "/metrics":
+            self.send_error(404)
+            return
+        try:
+            body = self.server.exporter.render().encode()
+        except Exception:
+            logger.exception("metrics render failed")
+            self.send_error(500)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # scrapes are not server log events
+        pass
+
+
+class MetricsExporter:
+    """Optional per-rank ``/metrics`` HTTP listener.
+
+    ``stats_fn()`` must return the RAW perf-stats tree (histogram
+    summaries carrying ``hist`` — ``get_perf_stats(raw=True)`` on a
+    server rank). ``port=0`` binds an ephemeral port (tests); the env
+    wiring in server.py only constructs an exporter when
+    ``DFT_METRICS_PORT`` > 0.
+    """
+
+    def __init__(self, stats_fn: Callable[[], dict], port: int = 0,
+                 host: str = "", rank=None):
+        self._stats_fn = stats_fn
+        self._labels = {} if rank is None else {"rank": rank}
+        self._httpd = HTTPServer((host, int(port)), _MetricsHandler)
+        self._httpd.exporter = self
+        self.port = self._httpd.server_address[1]
+        # daemon: the listener must never hold process exit hostage to a
+        # connected scraper; stop() below is the orderly path
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"metrics:r{rank if rank is not None else '?'}", daemon=True)
+
+    def render(self) -> str:
+        return render_prometheus(self._stats_fn(), labels=self._labels)
+
+    def start(self) -> "MetricsExporter":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():  # pragma: no cover - wedged handler
+            logger.warning("metrics listener thread did not exit in 5s")

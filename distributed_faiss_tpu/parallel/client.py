@@ -48,6 +48,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from distributed_faiss_tpu.mutation import versions as _versions
+from distributed_faiss_tpu.observability import spans as obs_spans
 from distributed_faiss_tpu.parallel import replication, rpc
 from distributed_faiss_tpu.utils import envutil, lockdep
 from distributed_faiss_tpu.utils.config import (
@@ -988,6 +989,7 @@ class IndexClient:
         deadline: Optional[float] = None,
         min_version=None,
         read_your_writes: bool = False,
+        trace_id: Optional[str] = None,
     ) -> tuple:  # (D, meta[, embs][, missing]) — see docstring
         """Fan-out search with client-side top-k merge.
 
@@ -1037,8 +1039,22 @@ class IndexClient:
         exists); only a whole group behind the version raises. Requires
         version-aware servers — a pre-version rank rejects the unknown
         argument like any bad-args application error.
+
+        Tracing (observability/): ``trace_id`` pins this search to an
+        explicit distributed trace; by default each call samples one via
+        ``DFT_TRACE_SAMPLE`` (0 = never — the frames stay byte-identical
+        to the pre-trace wire). A traced search records the whole-fan-out
+        ``client.search`` span and a ``client.failover`` span per failed
+        replica hop into the process-local SpanBuffer, and the id rides
+        every per-rank frame so the servers' stages attribute their
+        spans to it — fetch the merged timeline with
+        ``get_trace_spans(trace_id)``.
         """
         q_size = query.shape[0]
+        if trace_id is None:
+            trace_id = obs_spans.maybe_sample()
+        fanout_w0 = time.time() if trace_id is not None else 0.0
+        fanout_p0 = time.perf_counter()
         if read_your_writes:
             own = self.last_write_version(index_id)
             if min_version is None or _versions.compare(own, min_version) > 0:
@@ -1079,12 +1095,31 @@ class IndexClient:
                 (rpc.BusyError,), abs_deadline, idx.generic_fun,
                 "search", (index_id, query, topk, return_embeddings),
                 search_kwargs, timeout=timeout, deadline=abs_deadline,
+                trace_id=trace_id,
             )
 
         def note_failover(group, pos):
             with self._stats_lock:
                 self.counters["failovers"] += 1
                 self._preferred[group] = pos
+
+        def note_hop(group, idx, error, att_w0, att_p0):
+            """Span for a failed replica attempt (the failover hop a
+            merged timeline must show: which replica burned how much of
+            the budget before the group moved on). Wall-clock start,
+            monotonic duration — the spans-module contract."""
+            if trace_id is not None:
+                obs_spans.local_buffer().record(
+                    trace_id, "client.failover", att_w0,
+                    time.perf_counter() - att_p0, group=group,
+                    replica=idx.id, error=type(error).__name__)
+
+        def record_fanout():
+            if trace_id is not None:
+                obs_spans.local_buffer().record(
+                    trace_id, "client.search", fanout_w0,
+                    time.perf_counter() - fanout_p0, index_id=index_id,
+                    groups=len(plan), rows=int(q_size), topk=int(topk))
 
         if not allow_partial:
             # strict mode: a group with NO serving replica raises (the
@@ -1096,6 +1131,8 @@ class IndexClient:
                 last = None
                 for i, pos in enumerate(ordering):
                     idx = self.sub_indexes[pos]
+                    att_w0 = time.time() if trace_id is not None else 0.0
+                    att_p0 = time.perf_counter()
                     try:
                         out = call_stub(idx)
                     except rpc.TRANSPORT_ERRORS + (rpc.BusyError,) as e:
@@ -1103,6 +1140,7 @@ class IndexClient:
                             "replica %s (%s:%s) of group %s failed during "
                             "search, failing over: %s",
                             idx.id, idx.host, idx.port, group, e)
+                        note_hop(group, idx, e, att_w0, att_p0)
                         last = e
                         continue
                     except rpc.ServerException as e:
@@ -1121,6 +1159,7 @@ class IndexClient:
                                 "replica %s of group %s cannot serve this "
                                 "search yet (%s); failing over to a peer",
                                 idx.id, group, e)
+                            note_hop(group, idx, e, att_w0, att_p0)
                             last = e
                             continue
                         raise
@@ -1130,9 +1169,11 @@ class IndexClient:
                 raise last
 
             results = self.pool.map(one_strict, plan)
-            return IndexClient._aggregate_results(
+            merged = IndexClient._aggregate_results(
                 results, topk, q_size, maximize_metric, return_embeddings
             )
+            record_fanout()
+            return merged
 
         # partial mode: a group whose EVERY replica is transport-dead (or
         # still BUSY after the retry budget / past its deadline — alive
@@ -1148,11 +1189,14 @@ class IndexClient:
             fails = []
             for i, pos in enumerate(ordering):
                 idx = self.sub_indexes[pos]
+                att_w0 = time.time() if trace_id is not None else 0.0
+                att_p0 = time.perf_counter()
                 try:
                     out = call_stub(idx, timeout=partial_timeout)
                 except rpc.DeadlineExceeded as e:
                     # the call's budget is spent: another replica cannot
                     # answer any sooner, so the group degrades now
+                    note_hop(group, idx, e, att_w0, att_p0)
                     fails.append(_FailedRank(idx, e))
                     break
                 except rpc.TRANSPORT_ERRORS + (rpc.BusyError,) as e:
@@ -1160,6 +1204,7 @@ class IndexClient:
                         "replica %s (%s:%s) of group %s unreachable during "
                         "search; trying next replica: %s",
                         idx.id, idx.host, idx.port, group, e)
+                    note_hop(group, idx, e, att_w0, att_p0)
                     fails.append(_FailedRank(idx, e))
                     continue
                 except rpc.ServerException as e:
@@ -1171,6 +1216,7 @@ class IndexClient:
                     if ((replication.drain_failover_eligible(e)
                          or replication.stale_read_failover_eligible(e))
                             and i + 1 < len(ordering)):
+                        note_hop(group, idx, e, att_w0, att_p0)
                         fails.append(_FailedRank(idx, e))
                         continue
                     raise
@@ -1193,6 +1239,7 @@ class IndexClient:
         merged = IndexClient._aggregate_results(
             iter(ok), topk, q_size, maximize_metric, return_embeddings
         )
+        record_fanout()
         return merged + (missing,)
 
     @staticmethod
@@ -1465,11 +1512,24 @@ class IndexClient:
         counters — monotonic reroute/failover/under-replicated/
         quorum-failure totals, the bounded recent-reroute ring's length,
         and the repair queue's recorded/repaired/dropped/pending state —
-        mirroring how ``rpc.client`` carries the stub-side mux view."""
-        stats = list(self.pool.map(
-            lambda idx: self._call_with_retry(idx, "get_perf_stats"),
-            self.sub_indexes,
-        ))
+        mirroring how ``rpc.client`` carries the stub-side mux view.
+
+        Degraded mode (a dead/unreachable rank): the stats call is
+        exactly what an operator reaches for DURING an outage, so one
+        SIGKILLed rank must not fail the whole fan-out — its entry
+        degrades to a structured ``{"error": ..., "server", "host",
+        "port"}`` dict (plus this client's own view of the stub) and the
+        survivors' stats come back intact."""
+        def one(stub):
+            try:
+                return self._call_with_retry(stub, "get_perf_stats")
+            except rpc.TRANSPORT_ERRORS + (rpc.ServerException,
+                                           rpc.BusyError) as e:
+                return {"error": f"{type(e).__name__}: {e}",
+                        "server": stub.id, "host": stub.host,
+                        "port": stub.port}
+
+        stats = list(self.pool.map(one, self.sub_indexes))
         repl = self.get_replication_stats()
         for stub, entry in zip(self.sub_indexes, stats):
             if isinstance(entry, dict) and hasattr(stub, "rpc_stats"):
@@ -1477,6 +1537,27 @@ class IndexClient:
             if isinstance(entry, dict):
                 entry.setdefault("replication", {})["client"] = repl
         return stats
+
+    def get_trace_spans(self, trace_id: Optional[str] = None) -> list:
+        """One causal timeline for ``trace_id`` (or every retained span
+        when None): this process's local spans (stub round trips,
+        fan-out/failover hops) merged with every reachable rank's span
+        ring (the ``get_trace_spans`` op), deduped and sorted by start
+        time. Dead or pre-trace ranks are skipped — a trace fetched
+        DURING an outage shows the surviving stages, which is the
+        diagnosis that matters."""
+        def one(stub):
+            try:
+                return self._call_with_retry(stub, "get_trace_spans",
+                                             (trace_id,))
+            except rpc.TRANSPORT_ERRORS + (rpc.ServerException,
+                                           rpc.BusyError) as e:
+                logger.debug("trace fetch skipped rank %s: %s", stub.id, e)
+                return []
+
+        remote = list(self.pool.map(one, self.sub_indexes))
+        return obs_spans.merge_timelines(
+            obs_spans.local_buffer().snapshot(trace_id), *remote)
 
     def get_replication_stats(self) -> dict:
         """Client-side replication counters: monotonic totals, the recent

@@ -21,7 +21,9 @@ Frame layout (little-endian):
 
 Multiplexing (docs/OPERATIONS.md#wire-protocol-appendix): every CALL frame
 from a mux client carries a ``req_id`` in the optional trailing meta
-element (the same dict that carries ``deadline_s``), and the server
+element (the same dict that carries ``deadline_s`` and, for sampled
+requests, the distributed-tracing ``trace_id`` —
+observability/spans.py), and the server
 answers with *tagged* response kinds (``KIND_*_MUX``) whose payload is
 ``({"req_id": n}, body)`` — so many calls can be in flight per connection
 and complete out of order. Legacy peers interop: an old server ignores
@@ -43,6 +45,7 @@ import time
 
 import numpy as np
 
+from distributed_faiss_tpu.observability import spans as obs_spans
 from distributed_faiss_tpu.utils import envutil, lockdep
 from distributed_faiss_tpu.utils.tracing import LatencyStats
 
@@ -639,7 +642,7 @@ class Client:
             self._closed = False
 
     def generic_fun(self, fname: str, args=(), kwargs=None, timeout: float = None,
-                    deadline: float = None):
+                    deadline: float = None, trace_id: str = None):
         """Remote call. With ``timeout``, the socket gets a deadline for this
         call; on expiry the connection is closed (a partial frame would
         desync the stream) and socket.timeout propagates. Any transport
@@ -652,14 +655,23 @@ class Client:
         clock-skew-safe) so the server's scheduler can shed the request
         unserved once it can no longer answer in time, and it also bounds
         the socket wait. An already-expired deadline raises
-        ``DeadlineExceeded`` without touching the wire."""
+        ``DeadlineExceeded`` without touching the wire.
+
+        ``trace_id`` (a sampled request's id, observability/spans.py)
+        rides the frame meta beside ``req_id``/``deadline_s`` so the
+        server's stages attribute their spans to it; the stub records its
+        own ``client.pack`` / ``client.rpc`` spans into the process-local
+        SpanBuffer and stamps the id as the round-trip histogram's
+        exemplar. None (the default) adds no meta key and records
+        nothing — the wire stays byte-identical to the pre-trace frames."""
         if deadline is not None and deadline - time.time() <= 0:
             # cheap fast-fail before contending for the stub lock
             raise DeadlineExceeded(
                 f"deadline expired {time.time() - deadline:.3f}s before "
                 f"calling {fname}")
         if not self._mux:
-            return self._call_serial(fname, args, kwargs, timeout, deadline)
+            return self._call_serial(fname, args, kwargs, timeout, deadline,
+                                     trace_id)
         # ---- ensure a live connection (lock held briefly; may redial) ----
         with self._lock:
             # graftlint: ok(blocking-under-lock): redial backoff is bounded by RECONNECT_TIMEOUT and must serialize under the stub lock (connection state)
@@ -672,6 +684,8 @@ class Client:
         wait = timeout
         rid = next(self._req_counter)
         meta = {"req_id": rid}
+        if trace_id is not None:
+            meta["trace_id"] = trace_id  # spans.TRACE_META_KEY pins this spelling
         if deadline is not None:
             budget = deadline - time.time()
             if budget <= 0:
@@ -686,8 +700,15 @@ class Client:
         # and BEFORE touching the socket: a client-side pickling failure
         # (unpicklable argument) must raise without tearing down a healthy
         # connection — zero bytes have hit the wire.
+        if trace_id is not None:
+            w0, p0 = time.time(), time.perf_counter()
         parts = pack_frame(KIND_CALL, (fname, tuple(args), kwargs or {}, meta))
+        if trace_id is not None:
+            obs_spans.local_buffer().record(
+                trace_id, "client.pack", w0, time.perf_counter() - p0,
+                fname=fname, server=self.id)
         slot = _PendingCall(rid, fname)
+        w0 = time.time() if trace_id is not None else 0.0
         t0 = time.perf_counter()
         with self._lock:
             if self._shutdown:
@@ -748,18 +769,30 @@ class Client:
             raise slot.error
         # record completed round trips only (parity with the serial path:
         # a timeout/teardown must not land its wait ceiling in the p99)
-        self.stats.record("round_trip_s", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.stats.record("round_trip_s", dt, exemplar=trace_id)
+        if trace_id is not None:
+            # send -> demux completion: wire both ways PLUS the server's
+            # queue/launch time — the merged timeline subtracts the
+            # server-recorded spans to isolate the wire itself
+            obs_spans.local_buffer().record(
+                trace_id, "client.rpc", w0, dt, fname=fname, server=self.id,
+                host=self.host, port=self.port)
         return self._interpret(slot.kind, slot.payload, fname)
 
     # graftlint: ok(blocking-under-lock): the serial client holds the stub lock across the round trip BY DEFINITION (one call per connection); per-call `timeout` bounds the socket when the caller asks
-    def _call_serial(self, fname, args, kwargs, timeout, deadline):
+    def _call_serial(self, fname, args, kwargs, timeout, deadline,
+                     trace_id=None):
         """The pre-mux client: ``_lock`` held across the whole round trip,
-        frames only carry meta when a deadline is set (byte-compatible with
-        pre-deadline peers). Kept as the DFT_RPC_MUX=0 fallback and the
-        benchmark's A/B arm."""
+        frames only carry meta when a deadline (or a sampled trace) sets
+        a key (byte-compatible with pre-deadline peers). Kept as the
+        DFT_RPC_MUX=0 fallback and the benchmark's A/B arm."""
         with self._lock:
             self._ensure_connected_locked()
             budget = None
+            meta = {}
+            if trace_id is not None:
+                meta["trace_id"] = trace_id  # spans.TRACE_META_KEY pins this spelling
             if deadline is not None:
                 budget = deadline - time.time()
                 if budget <= 0:
@@ -768,12 +801,14 @@ class Client:
                         f"{fname}")
                 wait = budget + self.DEADLINE_GRACE
                 timeout = wait if timeout is None else min(timeout, wait)
+                meta["deadline_s"] = budget
             payload = (fname, tuple(args), kwargs or {})
-            if budget is not None:
-                payload = payload + ({"deadline_s": budget},)
+            if meta:
+                payload = payload + (meta,)
             parts = pack_frame(KIND_CALL, payload)
             if timeout is not None:
                 self.sock.settimeout(timeout)
+            w0 = time.time() if trace_id is not None else 0.0
             t0 = time.perf_counter()
             try:
                 _send_parts(self.sock, parts)
@@ -791,7 +826,12 @@ class Client:
             finally:
                 if timeout is not None and not self._closed:
                     self.sock.settimeout(None)
-        self.stats.record("round_trip_s", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.stats.record("round_trip_s", dt, exemplar=trace_id)
+        if trace_id is not None:
+            obs_spans.local_buffer().record(
+                trace_id, "client.rpc", w0, dt, fname=fname, server=self.id,
+                host=self.host, port=self.port)
         return self._interpret(kind, payload, fname)
 
     def fetch_shard(self, index_id: str, timeout: float = 120.0):

@@ -32,6 +32,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from distributed_faiss_tpu.engine import Index
+from distributed_faiss_tpu.observability import export as obs_export
+from distributed_faiss_tpu.observability import spans as obs_spans
 from distributed_faiss_tpu.parallel import antientropy, rpc
 from distributed_faiss_tpu.serving.scheduler import (
     DeadlineExpired,
@@ -44,6 +46,7 @@ from distributed_faiss_tpu.utils.config import (
     AntiEntropyCfg,
     IndexCfg,
     SchedulerCfg,
+    TracingCfg,
 )
 from distributed_faiss_tpu.utils.state import IndexState
 from distributed_faiss_tpu.utils.tracing import LatencyStats
@@ -77,7 +80,8 @@ class IndexServer:
     def __init__(self, rank: int, index_storage_dir: str,
                  scheduler_cfg: Optional[SchedulerCfg] = None,
                  discovery_path: Optional[str] = None,
-                 antientropy_cfg: Optional[AntiEntropyCfg] = None):
+                 antientropy_cfg: Optional[AntiEntropyCfg] = None,
+                 tracing_cfg: Optional[TracingCfg] = None):
         self.indexes: Dict[str, Index] = {}
         self.indexes_lock = lockdep.lock("IndexServer.indexes_lock")
         # index-level drop tombstones: ids this rank has dropped, so the
@@ -109,13 +113,24 @@ class IndexServer:
         # set_shard_group op; DFT_SHARD_GROUP pins it at launch (a rank
         # rejoining a known group after restart).
         self.shard_group: Optional[int] = envutil.env_int("DFT_SHARD_GROUP")
+        # distributed tracing (observability/): this rank's bounded span
+        # ring — every serving stage of a sampled request records into
+        # it; the get_trace_spans op is its read side. The optional
+        # Prometheus listener (DFT_METRICS_PORT) starts with the serving
+        # socket (_bind) and stops in stop().
+        self.tracing_cfg = (tracing_cfg if tracing_cfg is not None
+                            else TracingCfg.from_env())
+        self.spans = obs_spans.SpanBuffer(
+            capacity=self.tracing_cfg.buffer, rank=rank)
+        self._metrics: Optional[obs_export.MetricsExporter] = None
         cfg = scheduler_cfg if scheduler_cfg is not None else SchedulerCfg.from_env()
         self.scheduler: Optional[SearchScheduler] = None
         if cfg.enabled:
             self.scheduler = SearchScheduler(
                 self._engine_search_batched, cfg,
                 name=f"search-batcher:r{rank}",
-                tag={"rank": rank, "shard_group": self.shard_group})
+                tag={"rank": rank, "shard_group": self.shard_group},
+                span_buffer=self.spans)
         # request multiplexing: calls whose frame meta carries a req_id are
         # dispatched without blocking the connection's reader (search → the
         # scheduler's async completion path, everything else → this worker
@@ -376,9 +391,10 @@ class IndexServer:
     # ---------------------------------------------------------- anti-entropy
 
     def _wire_engine(self, index: Index) -> None:
-        """Install the compaction-lease gate on an engine entering the
-        registry (the sweeper re-asserts every sweep, so engines that
-        predate the sweeper converge too)."""
+        """Install the compaction-lease gate and this rank's span ring on
+        an engine entering the registry (the sweeper re-asserts every
+        sweep, so engines that predate the sweeper converge too)."""
+        index.span_buffer = self.spans
         if self._antientropy is not None:
             index.compaction_gate = self._antientropy.may_compact
 
@@ -509,7 +525,7 @@ class IndexServer:
         # XLA owns device parallelism; keep the knob for host-side libs
         os.environ["OMP_NUM_THREADS"] = str(num_threads)
 
-    def get_perf_stats(self) -> dict:
+    def get_perf_stats(self, raw: bool = False) -> dict:
         """Per-RPC latency summary {method: {count, total_s, mean_s, max_s,
         p50_s, p95_s, p99_s}}; with the serving scheduler enabled, the
         ``"scheduler"`` key adds its queue/batch distributions (queue_wait_s,
@@ -520,10 +536,18 @@ class IndexServer:
         legacy call counts, worker-pool size; IndexClient merges each
         stub's client-side view in under ``rpc.client``), and ``"engine"``
         the per-index device-launch latency distributions — wire, queue,
-        and device time side by side."""
-        out = self.perf.summary()
+        and device time side by side.
+
+        ``raw=True`` threads the raw-histogram view through every
+        LatencyStats block (bucket counts + trace exemplars) — the shape
+        the Prometheus exporter renders ``_bucket`` series from and
+        dfstat's shared ``delta`` rate math consumes. Rows whose bucket
+        retained a sampled exemplar also carry ``p99_exemplar``: the
+        trace_id to feed ``get_trace_spans`` when asking what made the
+        p99 spike."""
+        out = self.perf.summary(raw=raw)
         if self.scheduler is not None:
-            out["scheduler"] = self.scheduler.perf_stats()
+            out["scheduler"] = self.scheduler.perf_stats(raw=raw)
         with self._mux_lock:
             out["rpc"] = {"in_flight": self._mux_inflight,
                           **self._mux_counters}
@@ -541,12 +565,32 @@ class IndexServer:
                               else {"enabled": False})
         with self.indexes_lock:
             snapshot = list(self.indexes.items())
-        out["engine"] = {iid: idx.perf_stats() for iid, idx in snapshot}
+        out["engine"] = {iid: idx.perf_stats(raw=raw) for iid, idx in snapshot}
         # mutation observability (mutation subsystem): per-index tombstone
         # counts, live fraction, compaction run/aborted/fallback counters,
         # and compaction latency — docs/OPERATIONS.md#mutable-corpora
         out["mutation"] = {iid: idx.mutation_stats() for iid, idx in snapshot}
+        # tracing observability: span-ring occupancy/eviction and the
+        # metrics listener's bound port (0 = off) —
+        # docs/OPERATIONS.md#tracing--metrics-export. Snapshot the
+        # listener ref: stop() nulls it concurrently with outage-time
+        # stats calls, and this call degrading is exactly what the
+        # degrade satellite exists to prevent.
+        metrics = self._metrics
+        out["tracing"] = {
+            **self.spans.stats(),
+            "metrics_port": metrics.port if metrics else 0,
+        }
         return out
+
+    def get_trace_spans(self, trace_id: Optional[str] = None,
+                        limit: int = 4096) -> List[dict]:
+        """Read side of this rank's span ring (observability/spans.py):
+        the spans recorded for ``trace_id`` (or every retained span when
+        None), newest-last, capped at ``limit``. An ordinary RPC op — no
+        new frame kinds, so legacy peers simply never call it."""
+        spans = self.spans.snapshot(trace_id)
+        return spans[-int(limit):] if limit else spans
 
     def ping(self) -> dict:
         """Liveness/health probe (the reference has no failure detection
@@ -580,7 +624,13 @@ class IndexServer:
     def stop(self) -> None:
         logger.info("stopping server rank=%d", self.rank)
         self._stopping.set()
-        # stop the anti-entropy sweeper first: a sweep mid-heal would
+        # the metrics listener goes first: a scrape mid-shutdown would
+        # walk get_perf_stats over engines being saved; its thread is
+        # named, tracked, and joined inside MetricsExporter.stop()
+        if self._metrics is not None:
+            self._metrics.stop()
+            self._metrics = None
+        # stop the anti-entropy sweeper next: a sweep mid-heal would
         # race the shutdown saves for the engine locks, and its peer
         # dials are bounded so the join is too
         if self._antientropy is not None:
@@ -625,7 +675,30 @@ class IndexServer:
         s.bind(("", port))
         s.listen(16)
         self.socket = s
+        self._start_metrics()
         return s
+
+    def _start_metrics(self) -> None:
+        """Start the optional Prometheus listener once the serving socket
+        binds (both loops call _bind). DFT_METRICS_PORT is a BASE port —
+        rank r listens on base + r, so one knob covers a local multi-rank
+        launch. A bind failure (port taken) degrades to a logged warning:
+        metrics must never take serving down."""
+        base = self.tracing_cfg.metrics_port
+        if self._metrics is not None or base <= 0:
+            return
+        try:
+            self._metrics = obs_export.MetricsExporter(
+                lambda: self.get_perf_stats(raw=True),
+                port=base + self.rank, rank=self.rank).start()
+            logger.info("metrics listener rank=%d on :%d", self.rank,
+                        self._metrics.port)
+        # OverflowError: base + rank past 65535 (HTTPServer raises it,
+        # not OSError) — a misconfigured metrics port must degrade to a
+        # warning, never take the serving socket down with it
+        except (OSError, OverflowError) as e:
+            logger.warning("metrics listener for rank %d failed to bind "
+                           "port %d: %s", self.rank, base + self.rank, e)
 
     def start_blocking(self, port: int = rpc.DEFAULT_PORT, v6: bool = False,
                        load_index: bool = False) -> None:
@@ -705,20 +778,25 @@ class IndexServer:
             raise RuntimeError(f"unexpected frame kind {kind}")
         # 3-tuple (legacy) or 4-tuple with frame meta carrying the caller's
         # remaining deadline budget (relative seconds — clock-skew-safe;
-        # rebased onto this host's monotonic clock at decode) and, from mux
-        # clients, the req_id that pipelined dispatch tags responses with
+        # rebased onto this host's monotonic clock at decode), the sampled
+        # trace_id every serving stage attributes its spans to, and, from
+        # mux clients, the req_id that pipelined dispatch tags responses
+        # with
         fname, args, kwargs = payload[:3]
         frame_meta = payload[3] if len(payload) > 3 else None
         deadline = None
         req_id = None
+        trace_id = None
         if isinstance(frame_meta, dict):
             if frame_meta.get("deadline_s") is not None:
                 deadline = time.monotonic() + float(frame_meta["deadline_s"])
             req_id = frame_meta.get("req_id")
+            trace_id = frame_meta.get("trace_id")
         if req_id is None:
             with self._mux_lock:
                 self._mux_counters["legacy_calls"] += 1
-            self._call_sync(conn, fname, args, kwargs, deadline, eager_search)
+            self._call_sync(conn, fname, args, kwargs, deadline, eager_search,
+                            trace_id)
             return
         # mux dispatch: the reader never blocks on the call — the response
         # is written req_id-tagged under the connection's write lock by
@@ -729,12 +807,12 @@ class IndexServer:
         t0 = time.perf_counter()
         if fname == "search" and self.scheduler is not None:
             self._dispatch_scheduled(conn, wlock, args, kwargs, deadline,
-                                     req_id, t0)
+                                     req_id, t0, trace_id)
         else:
             try:
                 self._rpc_workers.submit(
                     self._dispatch_direct, conn, wlock, fname, args, kwargs,
-                    req_id, t0)
+                    req_id, t0, trace_id)
             except RuntimeError:  # pool already shut down (server stopping)
                 with self._mux_lock:
                     self._mux_inflight -= 1
@@ -785,7 +863,7 @@ class IndexServer:
         return None
 
     def _call_sync(self, conn, fname, args, kwargs, deadline,
-                   eager_search) -> None:
+                   eager_search, trace_id=None) -> None:
         """The legacy (no-req_id) path: serve the call on the reader thread
         and answer untagged, in order — an old client against a mux server
         works unchanged.
@@ -804,10 +882,11 @@ class IndexServer:
             if fname == "search" and self.scheduler is not None:
                 # admission-controlled path: queue bound + deadline shedding
                 ret = self._scheduled_search(args, kwargs, deadline,
-                                             eager_search)
+                                             eager_search, trace_id)
             else:
                 ret = fn(*args, **kwargs)
-            self.perf.record(fname, time.perf_counter() - t0)
+            self.perf.record(fname, time.perf_counter() - t0,
+                             exemplar=trace_id)
             kind, payload = rpc.KIND_RESULT, ret
         except Exception as e:
             busy = self._classify_scheduler_reject(e)
@@ -826,9 +905,16 @@ class IndexServer:
             tb = traceback.format_exc()
             logger.error("could not serialize %s response: %s", fname, tb)
             parts = rpc.pack_frame(rpc.KIND_ERROR, tb)
-        rpc._send_parts(conn, parts)
+        if trace_id is not None:
+            w0, p0 = time.time(), time.perf_counter()
+            rpc._send_parts(conn, parts)
+            self.spans.record(trace_id, "server.write", w0,
+                              time.perf_counter() - p0, fname=fname)
+        else:
+            rpc._send_parts(conn, parts)
 
-    def _scheduled_search(self, args, kwargs, deadline, eager=False):
+    def _scheduled_search(self, args, kwargs, deadline, eager=False,
+                          trace_id=None):
         """Normalize a search RPC's args onto the scheduler's submit."""
         vals = dict(zip(
             ("index_id", "query_batch", "top_k", "return_embeddings"), args))
@@ -837,7 +923,7 @@ class IndexServer:
         return self.scheduler.submit(
             vals["index_id"], vals["query_batch"], vals["top_k"],
             bool(vals.get("return_embeddings", False)), deadline=deadline,
-            eager=eager)
+            eager=eager, trace_id=trace_id)
 
     def _check_search_min_version(self, vals: dict) -> None:
         """Pop a search's ``min_version`` (read-your-writes) demand and
@@ -852,7 +938,7 @@ class IndexServer:
     # ------------------------------------------------------------ mux dispatch
 
     def _dispatch_scheduled(self, conn, wlock, args, kwargs, deadline,
-                            req_id, t0) -> None:
+                            req_id, t0, trace_id=None) -> None:
         """Hand a mux search to the scheduler without blocking the reader:
         the scheduler already completes out of order via per-request
         events, so its completion callback just enqueues the tagged
@@ -864,7 +950,7 @@ class IndexServer:
         def done(result, error):
             try:
                 self._rpc_workers.submit(self._finish_scheduled, conn, wlock,
-                                         req_id, result, error, t0)
+                                         req_id, result, error, t0, trace_id)
             except RuntimeError:
                 # pool already shut down (server stopping): the client's
                 # demux will fail the call when the connection drops
@@ -880,50 +966,52 @@ class IndexServer:
             self.scheduler.submit_async(
                 vals["index_id"], vals["query_batch"], vals["top_k"],
                 bool(vals.get("return_embeddings", False)),
-                deadline=deadline, callback=done)
+                deadline=deadline, callback=done, trace_id=trace_id)
         except Exception as e:
             # admission rejected (BUSY/deadline/stopped) or bad args:
             # answered synchronously — the request was never queued
-            self._finish_scheduled(conn, wlock, req_id, None, e, t0)
+            self._finish_scheduled(conn, wlock, req_id, None, e, t0, trace_id)
 
     def _finish_scheduled(self, conn, wlock, req_id, result, error,
-                          t0) -> None:
+                          t0, trace_id=None) -> None:
         if error is None:
-            self.perf.record("search", time.perf_counter() - t0)
+            self.perf.record("search", time.perf_counter() - t0,
+                             exemplar=trace_id)
             self._send_mux_response(conn, wlock, rpc.KIND_RESULT, result,
-                                    req_id, "search")
+                                    req_id, "search", trace_id)
             return
         busy = self._classify_scheduler_reject(error)
         if busy is not None:
             self.perf.record(busy[0], time.perf_counter() - t0)
             self._send_mux_response(conn, wlock, rpc.KIND_BUSY, busy[1],
-                                    req_id, "search")
+                                    req_id, "search", trace_id)
             return
         tb = "".join(traceback.format_exception(
             type(error), error, error.__traceback__))
         logger.error("exception in scheduled search: %s", tb)
         self._send_mux_response(conn, wlock, rpc.KIND_ERROR, tb,
-                                req_id, "search")
+                                req_id, "search", trace_id)
 
     def _dispatch_direct(self, conn, wlock, fname, args, kwargs, req_id,
-                         t0) -> None:
+                         t0, trace_id=None) -> None:
         """Worker-pool target for mux non-search ops."""
         try:
             if fname.startswith("_"):
                 raise AttributeError(fname)
             fn = getattr(self, fname)
             ret = fn(*args, **(kwargs or {}))
-            self.perf.record(fname, time.perf_counter() - t0)
+            self.perf.record(fname, time.perf_counter() - t0,
+                             exemplar=trace_id)
             self._send_mux_response(conn, wlock, rpc.KIND_RESULT, ret,
-                                    req_id, fname)
+                                    req_id, fname, trace_id)
         except Exception:
             tb = traceback.format_exc()
             logger.error("exception in %s: %s", fname, tb)
             self._send_mux_response(conn, wlock, rpc.KIND_ERROR, tb,
-                                    req_id, fname)
+                                    req_id, fname, trace_id)
 
     def _send_mux_response(self, conn, wlock, base_kind, payload, req_id,
-                           fname) -> None:
+                           fname, trace_id=None) -> None:
         """Write one req_id-tagged response frame under the connection's
         write lock. A write failure means the peer is gone — its demux has
         already failed the call client-side, so only log. Called exactly
@@ -938,8 +1026,16 @@ class IndexServer:
                 tb = traceback.format_exc()
                 logger.error("could not serialize %s response: %s", fname, tb)
                 parts = rpc.pack_tagged_response(rpc.KIND_ERROR, tb, req_id)
-            with wlock:
-                rpc._send_parts(conn, parts)
+            if trace_id is not None:
+                w0, p0 = time.time(), time.perf_counter()
+                with wlock:
+                    rpc._send_parts(conn, parts)
+                self.spans.record(trace_id, "server.write", w0,
+                                  time.perf_counter() - p0, fname=fname,
+                                  req_id=req_id)
+            else:
+                with wlock:
+                    rpc._send_parts(conn, parts)
         except OSError as e:
             logger.info("mux response write failed (%s req=%s): %s",
                         fname, req_id, e)

@@ -43,7 +43,12 @@ Observability rides the shared ``LatencyStats`` histogram surface
 (utils/tracing.py): queue-wait and end-to-end latency with streaming
 percentiles, batch occupancy (requests and rows per launch), queue depth
 at flush, and monotonic shed/busy counters — all exported through the
-rank's ``get_perf_stats`` RPC under the ``"scheduler"`` key.
+rank's ``get_perf_stats`` RPC under the ``"scheduler"`` key. Sampled
+requests (a non-None ``trace_id``) additionally record ``server.queue``
+(wait + which merge window they landed in and its occupancy) and
+``server.device`` (the window's launch) spans into the owning server's
+SpanBuffer, and stamp the latency histograms' exemplars
+(observability/spans.py).
 """
 
 import logging
@@ -53,6 +58,7 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from distributed_faiss_tpu.observability import spans as obs_spans
 from distributed_faiss_tpu.utils import lockdep
 from distributed_faiss_tpu.utils.config import SchedulerCfg
 from distributed_faiss_tpu.utils.tracing import LatencyStats
@@ -84,11 +90,12 @@ class SchedulerStopped(RuntimeError):
 class _Request:
     __slots__ = ("index_id", "q", "k", "return_embeddings", "deadline",
                  "eager", "enqueue_t", "event", "result", "error",
-                 "callback")
+                 "callback", "trace_id")
 
     def __init__(self, index_id: str, q: np.ndarray, k: int,
                  return_embeddings: bool, deadline: Optional[float],
-                 eager: bool = False, callback: Optional[Callable] = None):
+                 eager: bool = False, callback: Optional[Callable] = None,
+                 trace_id: Optional[str] = None):
         self.index_id = index_id
         self.q = q
         self.k = k
@@ -103,6 +110,11 @@ class _Request:
         # (result, error) when the request completes, instead of a thread
         # blocking on ``event``
         self.callback = callback
+        # sampled distributed trace this request belongs to (None for the
+        # unsampled default): queue-wait / coalesce / device spans are
+        # recorded against it, and it rides the latency histograms as
+        # their exemplar (observability/spans.py)
+        self.trace_id = trace_id
 
     @property
     def key(self) -> Tuple:
@@ -138,9 +150,13 @@ class SearchScheduler:
     """
 
     def __init__(self, search_fn: Callable, cfg: Optional[SchedulerCfg] = None,
-                 name: str = "search-batcher", tag: Optional[dict] = None):
+                 name: str = "search-batcher", tag: Optional[dict] = None,
+                 span_buffer=None):
         self._search_fn = search_fn
         self.cfg = cfg if cfg is not None else SchedulerCfg()
+        # span ring for sampled requests (the owning server's SpanBuffer):
+        # None (standalone schedulers, tracing off) records nothing
+        self.spans = span_buffer
         # replica identity riding the stats surface (replication layer):
         # admission behavior is unchanged per replica, but operators need
         # queue/shed numbers attributable to (rank, shard_group). Owned by
@@ -164,7 +180,8 @@ class SearchScheduler:
 
     def submit(self, index_id: str, query_batch: np.ndarray, top_k: int,
                return_embeddings: bool = False,
-               deadline: Optional[float] = None, eager: bool = False):
+               deadline: Optional[float] = None, eager: bool = False,
+               trace_id: Optional[str] = None):
         """Enqueue one search and block until its slice of a merged launch
         is ready. ``deadline`` is an absolute ``time.monotonic()`` instant;
         expired requests never reach the device. ``eager`` skips the
@@ -176,7 +193,7 @@ class SearchScheduler:
         still apply."""
         req = self.submit_async(index_id, query_batch, top_k,
                                 return_embeddings, deadline=deadline,
-                                eager=eager)
+                                eager=eager, trace_id=trace_id)
         # timeout-with-retry rather than one untimed wait: every admitted
         # request is eventually finished by the batcher (its loop survives
         # flush failures and stop() drains the queue) — the escape hatch
@@ -190,13 +207,15 @@ class SearchScheduler:
                     "in flight")
         if req.error is not None:
             raise req.error
-        self.stats.record("e2e_s", time.monotonic() - req.enqueue_t)
+        self.stats.record("e2e_s", time.monotonic() - req.enqueue_t,
+                          exemplar=req.trace_id)
         return req.result
 
     def submit_async(self, index_id: str, query_batch: np.ndarray,
                      top_k: int, return_embeddings: bool = False,
                      deadline: Optional[float] = None, eager: bool = False,
-                     callback: Optional[Callable] = None) -> _Request:
+                     callback: Optional[Callable] = None,
+                     trace_id: Optional[str] = None) -> _Request:
         """Admission-checked enqueue that returns immediately (the mux
         serving loops' entry: the connection reader must keep pulling
         frames). ``callback(result, error)`` fires exactly once — on the
@@ -209,7 +228,8 @@ class SearchScheduler:
         if q.ndim != 2:
             raise ValueError(f"query batch must be 2-D, got shape {q.shape}")
         req = _Request(index_id, q, int(top_k), bool(return_embeddings),
-                       deadline, eager=eager, callback=callback)
+                       deadline, eager=eager, callback=callback,
+                       trace_id=trace_id)
         with self._cond:
             if self._stopping:
                 raise SchedulerStopped("scheduler is stopped")
@@ -242,7 +262,8 @@ class SearchScheduler:
                 # e2e_s stays comparable between mux and legacy serving
                 # (shed/busy failures would otherwise pollute the p99
                 # with their queue-wait ceilings)
-                self.stats.record("e2e_s", time.monotonic() - req.enqueue_t)
+                self.stats.record("e2e_s", time.monotonic() - req.enqueue_t,
+                                  exemplar=req.trace_id)
             try:
                 req.callback(req.result, req.error)
             except Exception:
@@ -330,20 +351,51 @@ class SearchScheduler:
                     f"(waited {now - r.enqueue_t:.3f}s)")
                 self._finish(r)
                 continue
-            self.stats.record("queue_wait_s", now - r.enqueue_t)
+            self.stats.record("queue_wait_s", now - r.enqueue_t,
+                              exemplar=r.trace_id)
             live.append(r)
         if not live:
             return
         with self._cond:
             self._counters["batches"] += 1
+            window = self._counters["batches"]
+        n_rows = sum(r.rows for r in live)
         self.stats.record("batch_requests", float(len(live)))
-        self.stats.record("batch_rows", float(sum(r.rows for r in live)))
+        self.stats.record("batch_rows", float(n_rows))
+        traced = ([r for r in live if r.trace_id is not None]
+                  if self.spans is not None else [])
+        if traced:
+            # one queue span per sampled request: which merge window it
+            # landed in and that window's occupancy — the "why did my
+            # request wait / what did it share a launch with" answer
+            now_w = time.time()
+            for r in traced:
+                waited = now - r.enqueue_t
+                self.spans.record(
+                    r.trace_id, "server.queue", now_w - waited, waited,
+                    window=window, occupancy_requests=len(live),
+                    occupancy_rows=n_rows)
         head = live[0]
         try:
             qcat = head.q if len(live) == 1 else np.concatenate(
                 [r.q for r in live], axis=0)
-            result = self._search_fn(
-                head.index_id, qcat, head.k, head.return_embeddings)
+            if traced:
+                # hand the engine a representative trace for the launch
+                # (the whole window IS one device program, so one span
+                # per sampled request below shares its timing)
+                obs_spans.set_current_trace(traced[0].trace_id)
+                launch_w0, launch_p0 = time.time(), time.perf_counter()
+            try:
+                result = self._search_fn(
+                    head.index_id, qcat, head.k, head.return_embeddings)
+            finally:
+                if traced:
+                    launch_dt = time.perf_counter() - launch_p0
+                    obs_spans.set_current_trace(None)
+                    for r in traced:
+                        self.spans.record(
+                            r.trace_id, "server.device", launch_w0,
+                            launch_dt, window=window, rows=n_rows)
             if not isinstance(result, tuple):
                 result = (result,)
             offsets, ofs = [], 0
@@ -388,13 +440,15 @@ class SearchScheduler:
 
     # ---------------------------------------------------------- observability
 
-    def perf_stats(self) -> dict:
+    def perf_stats(self, raw: bool = False) -> dict:
         """{"counters": {...}, "queues": {metric: histogram summary}} —
-        merged into the rank's get_perf_stats surface under "scheduler"."""
+        merged into the rank's get_perf_stats surface under "scheduler";
+        ``raw`` adds the bucket histograms (the Prometheus exporter's
+        view)."""
         with self._cond:
             counters = dict(self._counters)
             counters["queued"] = len(self._queue)
-        out = {"counters": counters, "queues": self.stats.summary()}
+        out = {"counters": counters, "queues": self.stats.summary(raw=raw)}
         if self.tag:
             out["replica"] = dict(self.tag)
         return out

@@ -6,7 +6,15 @@ The reference has none of this beyond log lines (SURVEY §5.1); here:
   records every RPC dispatch and exposes them via the ``get_perf_stats``
   RPC (observability the reference lacks). The serving scheduler records
   queue-wait / batch-occupancy / queue-depth distributions into the same
-  structure (serving/scheduler.py).
+  structure (serving/scheduler.py). ``summary(raw=True)`` adds the raw
+  bucket counts (the Prometheus exporter's ``_bucket`` series,
+  observability/export.py) and per-bucket trace EXEMPLARS: ``record``
+  optionally retains the most recent sampled ``trace_id`` per bucket, so
+  a p99 row links directly to a fetchable distributed trace
+  (observability/spans.py — "what made p99 spike" answers itself).
+  ``LatencyStats.delta`` diffs two summaries so rate computation (the
+  dfstat CLI's ``--watch`` view) is shared library code, not ad-hoc CLI
+  math.
 - ``traced``        — context manager stamping a jax.named_scope (visible in
   xprof/tensorboard traces) and recording wall time into a LatencyStats.
 - ``profile_trace`` — wrapper around jax.profiler for capturing device
@@ -27,14 +35,36 @@ from typing import Dict, Optional
 _BUCKET_BOUNDS = tuple(1e-6 * 10 ** (i / 5) for i in range(46))
 _PERCENTILES = ((0.50, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s"))
 
+# exemplar freshness bound: a bucket's retained trace_id stops being
+# advertised this long after it was recorded. Matches the span rings'
+# reality — an evicted trace's id would send an operator chasing a
+# "no spans retained" dead lead — and comfortably exceeds any live
+# diagnosis loop's poll cadence.
+EXEMPLAR_TTL_S = 900.0
+
+
+def bucket_bounds() -> tuple:
+    """The fixed log-spaced bucket upper bounds every LatencyStats
+    histogram shares — what the Prometheus exporter renders as the
+    ``le`` labels of its cumulative ``_bucket`` series."""
+    return _BUCKET_BOUNDS
+
 
 class LatencyStats:
     def __init__(self):
         self._lock = threading.Lock()
         self._stats: Dict[str, Dict[str, float]] = {}
         self._hist: Dict[str, list] = {}
+        # per-op {bucket index: (most recent sampled trace_id, recorded
+        # monotonic instant)} — the exemplar linkage from a histogram row
+        # to a fetchable trace, aged out after EXEMPLAR_TTL_S so a stale
+        # id whose spans the rings evicted long ago is never advertised.
+        # Only populated for sampled requests, so the dict stays empty
+        # (and summary output byte-identical to pre-trace) when tracing
+        # is off.
+        self._exemplars: Dict[str, Dict[int, tuple]] = {}
 
-    def record(self, name: str, seconds: float) -> None:
+    def record(self, name: str, seconds: float, exemplar=None) -> None:
         with self._lock:
             s = self._stats.setdefault(
                 name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
@@ -45,8 +75,12 @@ class LatencyStats:
             hist = self._hist.setdefault(name, [0] * len(_BUCKET_BOUNDS))
             # bucket i holds values <= bounds[i]; out-of-range clamps to the
             # last bucket (its reported percentile saturates at the top edge)
-            hist[min(bisect.bisect_left(_BUCKET_BOUNDS, seconds),
-                     len(_BUCKET_BOUNDS) - 1)] += 1
+            bucket = min(bisect.bisect_left(_BUCKET_BOUNDS, seconds),
+                         len(_BUCKET_BOUNDS) - 1)
+            hist[bucket] += 1
+            if exemplar is not None:
+                self._exemplars.setdefault(name, {})[bucket] = (
+                    exemplar, time.monotonic())
 
     @staticmethod
     def _percentiles(hist, count, max_s) -> Dict[str, float]:
@@ -70,20 +104,97 @@ class LatencyStats:
                 break
         return out
 
-    def summary(self) -> Dict[str, Dict[str, float]]:
+    def summary(self, raw: bool = False) -> Dict[str, Dict[str, float]]:
+        """Per-op summary {count, total_s, max_s, mean_s, p50/95/99_s}.
+
+        ``raw=True`` additionally exposes the histogram itself —
+        ``"hist"`` (bucket counts aligned with ``bucket_bounds()``) and
+        ``"exemplars"`` ({bucket index: trace_id}) — the view the
+        Prometheus exporter and dfstat's shared rate math consume. Ops
+        with a FRESH tail exemplar (recorded within ``EXEMPLAR_TTL_S``)
+        at or past the p99 bucket also gain ``"p99_exemplar"``: the
+        trace_id to fetch when asking what made the p99 spike (present
+        in the default view too — it only appears once a sampled request
+        actually landed in the tail, so pre-trace output is unchanged,
+        and it ages out rather than advertising a trace the span rings
+        evicted long ago)."""
+        fresh_after = time.monotonic() - EXEMPLAR_TTL_S
         with self._lock:
             out = {}
             for name, s in self._stats.items():
+                hist = self._hist[name]
                 out[name] = dict(s)
                 out[name]["mean_s"] = s["total_s"] / max(s["count"], 1)
                 out[name].update(self._percentiles(
-                    self._hist[name], s["count"], s["max_s"]))
+                    hist, s["count"], s["max_s"]))
+                ex = {b: tid for b, (tid, t) in
+                      (self._exemplars.get(name) or {}).items()
+                      if t >= fresh_after}
+                if ex:
+                    tail = self._p99_exemplar(hist, s["count"], ex)
+                    if tail is not None:
+                        out[name]["p99_exemplar"] = tail
+                if raw:
+                    out[name]["hist"] = list(hist)
+                    out[name]["exemplars"] = ex
             return out
+
+    @staticmethod
+    def _p99_exemplar(hist, count, exemplars):
+        """The most recent sampled trace_id from the distribution's tail:
+        the exemplar of the lowest bucket at/above the p99 rank that has
+        one (tail requests land there by definition), else None."""
+        target = 0.99 * count
+        cum = 0
+        p99_bucket = len(hist) - 1
+        for i, n in enumerate(hist):
+            cum += n
+            if cum >= target:
+                p99_bucket = i
+                break
+        at_or_above = [b for b in exemplars if b >= p99_bucket]
+        return exemplars[min(at_or_above)] if at_or_above else None
+
+    @staticmethod
+    def delta(prev: Optional[Dict], cur: Dict) -> Dict[str, Dict]:
+        """Diff two ``summary()`` snapshots of cumulative counters into
+        the interval's own numbers — the one shared rate computation the
+        dfstat CLI, tests, and any polling exporter all use. For every op
+        in ``cur``: ``count``/``total_s`` are interval deltas (``prev``
+        None or missing the op treats its baseline as zero),
+        ``interval_mean_s`` is the interval's mean latency, and ``hist``
+        (when both snapshots are raw) the interval's bucket counts. A
+        counter that went BACKWARD (the rank restarted and its cumulative
+        stats reset) is reported from zero rather than as a negative
+        rate."""
+        prev = prev or {}
+        out = {}
+        for name, c in cur.items():
+            if not isinstance(c, dict) or "count" not in c:
+                continue
+            p = prev.get(name) or {}
+            restarted = p.get("count", 0) > c["count"]
+            base = {} if restarted else p
+            d_count = c["count"] - base.get("count", 0)
+            d_total = c["total_s"] - base.get("total_s", 0.0)
+            row = {
+                "count": d_count,
+                "total_s": d_total,
+                "interval_mean_s": d_total / d_count if d_count else 0.0,
+                "max_s": c.get("max_s", 0.0),
+            }
+            if "hist" in c:
+                ph = base.get("hist")
+                row["hist"] = ([n - (ph[i] if ph and i < len(ph) else 0)
+                                for i, n in enumerate(c["hist"])])
+            out[name] = row
+        return out
 
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()
             self._hist.clear()
+            self._exemplars.clear()
 
 
 @contextlib.contextmanager

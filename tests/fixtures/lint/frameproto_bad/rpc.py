@@ -1,6 +1,7 @@
 """frame-protocol known-bad fixture (protocol module): a duplicated
-wire value, an unregistered tagged kind, a dead kind, and a client pack
-site whose arity the paired server over-unpacks."""
+wire value, an unregistered tagged kind, a dead kind, a client pack
+site whose arity the paired server over-unpacks, and CALL meta keys
+the paired server never reads."""
 
 KIND_CALL = 0
 KIND_RESULT = 1
@@ -35,6 +36,11 @@ class Client:
         send_frame(self.sock, KIND_CALL, (fname, args))
         kind, payload = recv_frame(self.sock)
         return self._interpret(kind, payload)
+
+    def call_traced(self, fname, args):
+        meta = {"req_id": 1}  # meta keys the server's _one_call never
+        meta["trace"] = "t"   # reads (.get) — dead on the wire
+        send_frame(self.sock, KIND_CALL, (fname, args, meta))
 
     def close(self):
         send_frame(self.sock, KIND_CLOSE, None)

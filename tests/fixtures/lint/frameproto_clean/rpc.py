@@ -28,7 +28,8 @@ def recv_frame(sock):
 
 class Client:
     def call(self, fname, args, kwargs):
-        send_frame(self.sock, KIND_CALL, (fname, args, kwargs, {"req_id": 0}))
+        meta = {"req_id": 0}  # every meta key is read by _one_call
+        send_frame(self.sock, KIND_CALL, (fname, args, kwargs, meta))
         kind, payload = recv_frame(self.sock)
         return self._interpret(kind, payload)
 

@@ -13,6 +13,9 @@ class Server:
         if kind != rpc.KIND_CALL:
             raise RuntimeError(f"unexpected frame kind {kind}")
         fname, args, kwargs = payload[:3]  # meta element stays optional
+        meta = payload[3] if len(payload) > 3 else None
+        req_id = meta.get("req_id") if isinstance(meta, dict) else None
+        assert req_id is None or isinstance(req_id, int)
         try:
             ret = getattr(self, fname)(*args, **kwargs)
             rpc.send_frame(conn, rpc.KIND_RESULT, ret)

@@ -174,9 +174,11 @@ def test_frameproto_bad_fixture():
     locs = sorted((f.rule, os.path.basename(f.path), f.line)
                   for f in _lint(f"{FIX}/frameproto_bad"))
     assert locs == [
-        ("frame-protocol", "rpc.py", 10),     # duplicate wire value
-        ("frame-protocol", "rpc.py", 12),     # unregistered tagged kind
-        ("frame-protocol", "rpc.py", 13),     # dead kind
+        ("frame-protocol", "rpc.py", 11),     # duplicate wire value
+        ("frame-protocol", "rpc.py", 13),     # unregistered tagged kind
+        ("frame-protocol", "rpc.py", 14),     # dead kind
+        ("frame-protocol", "rpc.py", 41),     # meta key 'req_id' unread
+        ("frame-protocol", "rpc.py", 42),     # meta key 'trace' unread
         ("frame-protocol", "server.py", 15),  # CALL arity over-unpack
         ("frame-protocol", "server.py", 23),  # KIND_BUSY unhandled by client
         ("frame-protocol", "server.py", 27),  # KIND_PROGRESS unhandled

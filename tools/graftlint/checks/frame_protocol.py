@@ -25,6 +25,14 @@ named ``rpc.py`` defining ``KIND_*`` constants, paired with the
   unguarded ``a, b, c = payload`` against a 4-element frame is a
   ValueError on every call; ``payload[:3]`` must not slice more than the
   smallest pack site provides);
+- **meta-key contract** — the CALL frame's optional trailing meta dict
+  is the extensible half of the protocol (``req_id``, ``deadline_s``,
+  the tracing ``trace_id``): every key the client class stores into its
+  ``meta`` dict (literal or ``meta["k"] = ...``) must be read by the
+  paired server's ``_one_call`` (a ``.get("k")``) — an unread key is
+  wire surface the in-repo server silently drops, i.e. a feature that
+  only APPEARS to propagate. (Old peers ignoring unknown keys is the
+  compat contract; the in-repo pair agreeing is this checker's.)
 - **dead kinds** — a kind defined but never referenced again is wiring
   someone forgot to finish;
 - **stale pins** — every entry of the lock-discipline ``PINS`` map
@@ -228,6 +236,7 @@ def _check_protocols(model):
                     )
 
             yield from _check_call_arity(mod, server, kinds, client_cls)
+            yield from _check_call_meta(mod, server, client_cls)
 
         # --- dead kinds -------------------------------------------------
         referenced = set()
@@ -313,6 +322,52 @@ def _check_call_arity(mod, server, kinds, client_cls):
                         f"KIND_CALL payload, but a client pack site sends "
                         f"only {lo} ({mod.relpath}:{arities[lo]})",
                     )
+
+
+def _check_call_meta(mod, server, client_cls):
+    """CALL-frame meta contract: every key the client stores into a
+    ``meta`` dict (the optional trailing element of a KIND_CALL payload)
+    must be consumed by the paired server's ``_one_call`` via
+    ``.get("<key>")``. Conventions this resolves: dict literals assigned
+    to a variable named ``meta`` and constant-string subscript stores
+    into one (the two shapes the in-repo client uses)."""
+    if client_cls is None:
+        return
+    sent = {}  # key -> first client line that sets it
+    for sub in ast.walk(client_cls):
+        if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
+            continue
+        t = sub.targets[0]
+        if (isinstance(t, ast.Name) and t.id == "meta"
+                and isinstance(sub.value, ast.Dict)):
+            for k in sub.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    sent.setdefault(k.value, sub.lineno)
+        elif (isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name) and t.value.id == "meta"
+                and isinstance(t.slice, ast.Constant)
+                and isinstance(t.slice.value, str)):
+            sent.setdefault(t.slice.value, sub.lineno)
+    if not sent:
+        return
+    consumed = set()
+    for f in _functions_named(server, "_one_call"):
+        for sub in ast.walk(f.node):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "get" and sub.args
+                    and isinstance(sub.args[0], ast.Constant)
+                    and isinstance(sub.args[0].value, str)):
+                consumed.add(sub.args[0].value)
+    for key in sorted(sent):
+        if key not in consumed:
+            yield Finding(
+                RULE, mod.relpath, sent[key], 0,
+                f"client CALL frames carry meta key {key!r} that the "
+                "paired server's _one_call never reads — the key is dead "
+                "on the wire against in-repo peers (read it with "
+                f"frame_meta.get({key!r}) or stop sending it)",
+            )
 
 
 # ------------------------------------------------------------------ pin audit

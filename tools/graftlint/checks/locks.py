@@ -137,6 +137,13 @@ PINS = {
     ("IndexClient", "_unversioned_ranks"): "_stats_lock",
     ("HLC", "_last_ms"): "_lock",
     ("HLC", "_counter"): "_lock",
+    # observability subsystem (observability/spans.py): the span ring is
+    # appended by every serving stage of a sampled request — connection
+    # readers, the scheduler's batcher, worker-pool response writers,
+    # client fan-out threads — and snapshotted by the get_trace_spans op
+    # and the perf-stats tracing block
+    ("SpanBuffer", "_spans"): "_lock",
+    ("SpanBuffer", "_counters"): "_lock",
 }
 
 # the modules the pinned classes live in: the frame-protocol stale-pin
@@ -152,6 +159,7 @@ PIN_HOMES = (
     "parallel/replication.py",
     "parallel/antientropy.py",
     "mutation/versions.py",
+    "observability/spans.py",
     "testing/chaos.py",
 )
 
