@@ -319,6 +319,128 @@ def run_mux_arms(idx, queries, k, arm, inflight, reps, backend,
     return rows
 
 
+def _serialize_microbench(idx, queries, k, encoding, mux_batch, iters=200):
+    """Median per-frame-pair (search CALL + tagged RESULT) encode+decode
+    cost under one skeleton encoding, measured over a socketpair
+    in-process. The deterministic half of the --wire A/B: loopback QPS
+    on a compute-bound CPU backend is noisy, the serialization cost per
+    frame is not. Returns microseconds per CALL+RESULT round."""
+    import socket as socketlib
+
+    from distributed_faiss_tpu.parallel import rpc
+
+    q = queries[0][:mux_batch]
+    result = idx.search_batched(q, k)
+    meta = {"req_id": 1, "wire": 1}
+    a, b = socketlib.socketpair()
+    try:
+        def one_round(i):
+            if encoding == "binary":
+                call = rpc.pack_binary_call("search", ("bench", q, k, False),
+                                            {}, meta)
+                resp = rpc.pack_binary_response(rpc.KIND_RESULT, result, i)
+                assert call is not None and resp is not None
+            else:
+                call = rpc.pack_frame(
+                    rpc.KIND_CALL, ("search", ("bench", q, k, False), {},
+                                    meta))
+                resp = rpc.pack_tagged_response(rpc.KIND_RESULT, result, i)
+            rpc._send_parts(a, call)
+            rpc.recv_frame(b)
+            rpc._send_parts(b, resp)
+            rpc.recv_frame(a)
+
+        one_round(0)  # warm
+        t0 = time.perf_counter()
+        for i in range(iters):
+            one_round(i)
+        return (time.perf_counter() - t0) / iters * 1e6
+    finally:
+        a.close()
+        b.close()
+
+
+def run_wire_arms(idx, queries, k, arm, inflight, reps, backend,
+                  mux_batch=4):
+    """Binary-wire A/B (ISSUE 14): the same loopback server + ONE mux
+    IndexClient per arm, with DFT_RPC_WIRE flipped client-side —
+    ``pickle`` never advertises, so the whole path stays on pickle
+    skeletons; ``binary`` negotiates per connection and the hot search
+    frames ride the compact binary encoding. Each row reports QPS/p99,
+    the identity check vs sequential pickle serving, whether the stub
+    actually negotiated, and the in-process per-frame serialization
+    microbench (encode+decode of one CALL+RESULT pair)."""
+    from distributed_faiss_tpu.parallel.client import IndexClient
+
+    srv, disc, teardown = _loopback_server(idx)
+    qlist = _warmed_request_list(idx, queries, k, inflight, mux_batch)
+    arms = [("wire_pickle", "pickle")] if arm in ("pickle", "both") else []
+    if arm in ("binary", "both"):
+        arms.append(("wire_binary", "binary"))
+
+    rows = []
+    saved = os.environ.get("DFT_RPC_WIRE")
+    try:
+        os.environ["DFT_RPC_WIRE"] = "pickle"
+        ref = IndexClient(disc)
+        ref.cfg = idx.cfg
+        golden = [ref.search(q, k, "bench") for q in qlist]
+        ref.close()
+        for name, env in arms:
+            os.environ["DFT_RPC_WIRE"] = env
+            client = IndexClient(disc)
+            client.cfg = idx.cfg
+            client.search(qlist[0], k, "bench")  # dial + negotiate
+
+            res = [[] for _ in qlist]
+            errs = []
+            barrier = threading.Barrier(inflight)
+
+            def caller(t, client=client, res=res, errs=errs,
+                       barrier=barrier):
+                barrier.wait()
+                try:
+                    for _ in range(reps):
+                        res[t].append(client.search(qlist[t], k, "bench"))
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            ts = [threading.Thread(target=caller, args=(t,))
+                  for t in range(inflight)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs, (name, errs[:1])
+            identical = all(
+                len(res[t]) == reps
+                and all(np.array_equal(sc, golden[t][0]) and m == golden[t][1]
+                        for sc, m in res[t])
+                for t in range(len(qlist)))
+
+            qps, p99 = run_clients(
+                lambda q, kk, client=client: client.search(q, kk, "bench"),
+                qlist, inflight, reps, k)
+            negotiated = client.sub_indexes[0].rpc_stats()["peer_wire"]
+            rows.append({
+                "case": name, "backend": backend, "threads": inflight,
+                "batch": qlist[0].shape[0], "qps": round(qps, 1),
+                "p99_ms": round(p99, 2), "identical": identical,
+                "negotiated": negotiated,
+                "serialize_us_per_call_result": round(
+                    _serialize_microbench(idx, queries, k, env, mux_batch),
+                    2),
+            })
+            client.close()
+    finally:
+        if saved is None:
+            os.environ.pop("DFT_RPC_WIRE", None)
+        else:
+            os.environ["DFT_RPC_WIRE"] = saved
+        teardown()
+    return rows
+
+
 def run_trace_arms(idx, queries, k, inflight, reps, backend, mux_batch=4):
     """Tracing-overhead A/B (the ISSUE 13 acceptance number): the same
     loopback server + ONE mux IndexClient serving ``inflight`` caller
@@ -731,6 +853,14 @@ def main():
         help="rows per request in the mux arms (default 4: user-sized "
              "requests riding the per-launch dispatch floor)")
     parser.add_argument(
+        "--wire", choices=("binary", "pickle", "both", "none"),
+        default="none",
+        help="binary-wire A/B arm(s): the mux serving path with "
+             "DFT_RPC_WIRE=pickle vs binary on the same engine — per-arm "
+             "qps/p99, cross-arm identity, negotiation check, and an "
+             "in-process per-frame serialization microbench (default: "
+             "none)")
+    parser.add_argument(
         "--trace-sample", action="store_true",
         help="tracing-overhead A/B arm: the mux serving path with "
              "DFT_TRACE_SAMPLE=0 vs 1 on the same engine — one JSON row "
@@ -783,7 +913,8 @@ def main():
 
     modes = [m for m in args.modes.split(",") if m]
     need_single = (bool(modes) or args.scheduler != "none"
-                   or args.mux != "none" or args.trace_sample)
+                   or args.mux != "none" or args.trace_sample
+                   or args.wire != "none")
     if need_single:
         rng = np.random.default_rng(0)
         centers = rng.standard_normal((256, d)).astype(np.float32) * 4.0
@@ -842,6 +973,23 @@ def main():
             # reached the scheduler as one merged batch (impossible with
             # the serial stub)
             assert by_case["rpc_mux_on"]["merged_batch_max"] > 1, by_case
+
+    if args.wire != "none":
+        rows = run_wire_arms(idx, queries, k, args.wire, args.inflight,
+                             reps, backend, mux_batch=args.mux_batch)
+        for row in rows:
+            print(json.dumps(row), flush=True)
+        assert all(r["identical"] for r in rows), \
+            f"wire results diverged from sequential pickle serving: {rows}"
+        by_case = {r["case"]: r for r in rows}
+        if "wire_binary" in by_case:
+            assert by_case["wire_binary"]["negotiated"] is True, by_case
+        if len(by_case) == 2:
+            # the tentpole number: the binary skeleton encodes+decodes a
+            # CALL+RESULT pair measurably cheaper than pickle
+            assert (by_case["wire_binary"]["serialize_us_per_call_result"]
+                    < by_case["wire_pickle"]["serialize_us_per_call_result"]), \
+                by_case
 
     if args.trace_sample:
         rows = run_trace_arms(idx, queries, k, args.inflight, reps,

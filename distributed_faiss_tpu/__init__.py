@@ -39,6 +39,7 @@ _LAZY = {
     "AntiEntropyCfg": ("distributed_faiss_tpu.utils.config", "AntiEntropyCfg"),
     "VersioningCfg": ("distributed_faiss_tpu.utils.config", "VersioningCfg"),
     "TracingCfg": ("distributed_faiss_tpu.utils.config", "TracingCfg"),
+    "WireCfg": ("distributed_faiss_tpu.utils.config", "WireCfg"),
     "HLC": ("distributed_faiss_tpu.mutation.versions", "HLC"),
     "QuorumError": ("distributed_faiss_tpu.parallel.client", "QuorumError"),
     "MembershipTable": ("distributed_faiss_tpu.parallel.replication",

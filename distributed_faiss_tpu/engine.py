@@ -312,6 +312,11 @@ class Index:
             # upsert refresh)
             "version_noop_adds": 0, "version_noop_deletes": 0,
             "version_replaced": 0,
+            # deletion-ledger version pairs dropped once every registered
+            # replica's watermark passed them (sweeper-driven,
+            # engine.prune_ledger): the bound on sidecar growth under
+            # delete-heavy churn
+            "ledger_pruned": 0,
         }
         # per-id mutation versioning (ISSUE 12): per-WRITER watermarks of
         # the newest version this shard has incorporated (the
@@ -993,12 +998,27 @@ class Index:
         emb, metas, _vers = self._export_rows(ids)
         return emb, metas
 
-    def export_rows_versioned(self, ids) -> Tuple[np.ndarray, list, list]:
+    def export_rows_versioned(self, ids, with_hash: bool = False):
         """``export_rows`` plus each row's live write version (None for
         rows that were never versioned-written) — the pull side of a
         versioned delta repair: the puller applies the rows through the
-        LWW add gates instead of blindly appending."""
-        return self._export_rows(ids)
+        LWW add gates instead of blindly appending.
+
+        ``with_hash=True`` appends a per-chunk content hash
+        (``serialization.row_payload_hash`` over the embedding plane +
+        metadata/version lists) as a 4th element: the pulling sweeper
+        verifies it BEFORE applying the rows, so a transport-corrupted
+        chunk can never be installed as repaired state. Kept behind a
+        keyword (default off, 3-tuple unchanged) so PR-12 sweepers
+        calling the bare op keep working across a rolling upgrade; a
+        NEW sweeper against a pre-hash server degrades per heal (the
+        unexpected-keyword ServerException fallback,
+        antientropy._heal)."""
+        emb, metas, vers = self._export_rows(ids)
+        if not with_hash:
+            return emb, metas, vers
+        return emb, metas, vers, serialization.row_payload_hash(
+            emb, metas, vers)
 
     # graftlint: ok(blocking-under-lock): designed locked fetch — rows and their metadata must come from one atomic index state (repair path, never hot)
     def _export_rows(self, ids) -> Tuple[np.ndarray, list, list]:
@@ -1048,6 +1068,36 @@ class Index:
             metas = [m for j, m in enumerate(metas) if keep[j]]
             vers = [v for j, v in enumerate(vers) if keep[j]]
         return out, metas, vers
+
+    def prune_ledger(self, min_watermark, min_age_s: float = 0.0) -> int:
+        """Drop deletion-ledger version pairs whose delete version is
+        STRICTLY below ``min_watermark`` — safe once every registered
+        replica's watermark has passed them (each replica has provably
+        incorporated, or been outranked past, the delete), which is the
+        sweeper's call to make (antientropy.AntiEntropySweeper: all
+        group peers contacted this round, none suspect, digests
+        matched) — AND at least ``min_age_s`` old (wall-clock component
+        of the HLC stamp): replica watermarks cannot see a CLIENT's
+        bounded repair queue, whose replay of a pre-delete add carries a
+        stamp the pruned pair existed to gate, so young entries wait out
+        the repair-replay window (DFT_LEDGER_PRUNE_AGE_S). Unversioned
+        (legacy) entries are never pruned — nothing can prove every peer
+        saw them. The shrunken ledger is persisted through the same
+        versioned sidecar writer as every mutation, so a crash between
+        prune and write merely re-prunes later. Returns the entries
+        dropped (counted in ``mutation_stats()["ledger_pruned"]``)."""
+        cutoff = (int(time.time() * 1000.0 - min_age_s * 1000.0)
+                  if min_age_s > 0 else None)
+        with self.buffer_lock, self.index_lock:
+            pruned = self.tombstones.prune_ledger(min_watermark,
+                                                  max_wall_ms=cutoff)
+            if not pruned:
+                return 0
+            self._mutation_counters["ledger_pruned"] += pruned
+            self._digest_cache = None
+            payload, sc_version = self._tombstone_payload_locked()
+        self._write_tombstone_sidecar(payload, sc_version)
+        return pruned
 
     def reconcile_deletes(self, dead_keys, dead_versions=None) -> int:
         """Apply a peer's deletion ledger. Versioned (``dead_versions``:

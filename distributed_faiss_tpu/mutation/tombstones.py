@@ -195,6 +195,31 @@ class TombstoneSet:
                 self._ledger.get(kk), _versions.version_key(v))
         return len(self._ledger) - before
 
+    def prune_ledger(self, min_version, max_wall_ms=None) -> int:
+        """Drop ledger entries whose delete version is STRICTLY below
+        ``min_version`` (the cluster-wide minimum replica watermark —
+        every registered replica has provably incorporated the delete,
+        so the never-resurrect guard is no longer needed for it) AND, when
+        ``max_wall_ms`` is given, whose wall-clock component is at most
+        that old — the age bound covers the one writer the watermark
+        floor cannot see: a CLIENT whose bounded repair queue still holds
+        a pre-delete add for a replica that was down (the replayed stamp
+        would sail through the LWW gates once its ledger pair is gone).
+        Entries with version None (legacy/unversioned deletes) are NEVER
+        pruned: nothing proves a peer saw them. Returns entries dropped.
+        This is what keeps the sidecar from growing without bound under
+        delete-heavy churn (engine.prune_ledger owns the persistence and
+        the counter)."""
+        mk = _versions.version_key(min_version)
+        if mk is None:
+            return 0
+        victims = [k for k, v in self._ledger.items()
+                   if v is not None and _versions.compare(v, mk) < 0
+                   and (max_wall_ms is None or v[0] <= max_wall_ms)]
+        for k in victims:
+            del self._ledger[k]
+        return len(victims)
+
     def unledger(self, keys: Iterable) -> int:
         """Drop ledger entries for ids that were legally re-added (upsert
         visibility: a re-ingested id must become pullable again)."""

@@ -61,7 +61,7 @@ from typing import Dict, List, Optional, Tuple
 from distributed_faiss_tpu.mutation import versions as _versions
 from distributed_faiss_tpu.mutation.tombstones import id_match_key
 from distributed_faiss_tpu.parallel import replication, rpc
-from distributed_faiss_tpu.utils import lockdep
+from distributed_faiss_tpu.utils import lockdep, serialization
 from distributed_faiss_tpu.utils.config import AntiEntropyCfg
 
 logger = logging.getLogger()
@@ -233,7 +233,16 @@ class AntiEntropySweeper:
         self._counters = {"sweeps": 0, "digests_matched": 0,
                           "digests_mismatched": 0, "rows_repaired": 0,
                           "rows_refreshed": 0, "full_syncs": 0,
-                          "empty_deltas": 0}
+                          "empty_deltas": 0,
+                          # content-hash verification of refresh pulls
+                          # (ISSUE 14): chunks whose sha256 did not match
+                          # what the peer claimed to send — transport
+                          # corruption, never applied
+                          "chunk_hash_mismatch": 0,
+                          # deletion-ledger version pairs pruned once
+                          # every registered replica's watermark passed
+                          # them (engine.prune_ledger)
+                          "ledger_pruned": 0}
         self._last_empty_warn = float("-inf")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -296,9 +305,18 @@ class AntiEntropySweeper:
             # idempotent re-assert: engines created before the sweeper
             # started (or restored by a load) get the lease gate too
             engine.compaction_gate = self.may_compact
+        # ledger-prune evidence for this round: per index, the watermark
+        # of every GROUP peer contacted (prune needs all of them), and
+        # the indexes something disqualified (mismatch, peer missing the
+        # index, pre-prune peer). Any dial failure blocks the whole round.
+        prune_watermarks = {iid: [] for iid in engines}
+        prune_blocked = set()
+        prune_unsafe = False
+        round_peers = set()
         for host, port in read_peers(self.discovery_path):
             if self._is_self(host, port):
                 continue
+            round_peers.add((host, port))
             known, peer_group = self.health.known_group(host, port)
             # only a CONCRETE different group skips — a cached None means
             # the peer had not registered yet (set_shard_group arrives
@@ -324,7 +342,27 @@ class AntiEntropySweeper:
             self.health.note_ok((host, port), peer_rank, peer_group)
             summary["contacted"] += 1
             if my_group is None or peer_group != my_group:
+                if peer_group is None:
+                    # an UNREGISTERED peer (fresh restart without
+                    # DFT_SHARD_GROUP, no client has pushed its group
+                    # yet) might be a member of OUR group: it can
+                    # neither prove a watermark nor compare digests, so
+                    # it must block this round's ledger pruning exactly
+                    # like a failed dial — pruning past a delete it may
+                    # be missing would let its stale rows resurrect
+                    prune_unsafe = True
                 continue  # liveness only — digests compare within a group
+            peer_wms = resp.get("watermarks")
+            for iid in engines:
+                # a peer that sends no watermark map (pre-prune build),
+                # lacks the index, or has no versioned state cannot prove
+                # it incorporated any delete — its indexes stay unpruned
+                wm = (peer_wms or {}).get(iid)
+                vk = _versions.version_key(wm)
+                if vk is None:
+                    prune_blocked.add(iid)
+                else:
+                    prune_watermarks[iid].append(vk)
             peer_digests = resp.get("digests") or {}
             for index_id, theirs in sorted(peer_digests.items()):
                 with server.indexes_lock:
@@ -355,16 +393,83 @@ class AntiEntropySweeper:
                     self._bump("digests_matched")
                     continue
                 self._bump("digests_mismatched")
+                prune_blocked.add(index_id)  # heal first, prune next round
                 try:
                     out = self._heal(index_id, engine, host, port)
                     out.update(index_id=index_id, peer=(host, port))
                     summary["healed"].append(out)
+                except rpc.TRANSPORT_ERRORS as e:
+                    # the peer died mid-heal, or a pulled chunk failed
+                    # content-hash verification twice (FrameError from
+                    # _fetch_chunk_verified): transport evidence — feed
+                    # the failure detector like a failed digest dial
+                    self.health.note_fail((host, port),
+                                          self.cfg.suspect_after, e)
+                    summary["failed"] += 1
+                    logger.warning(
+                        "anti-entropy: heal of %r from %s:%d failed on "
+                        "transport: %s", index_id, host, port, e)
                 except Exception:
                     logger.exception(
                         "anti-entropy: heal of %r from %s:%d failed",
                         index_id, host, port)
+        if not prune_unsafe:
+            self._prune_ledgers(engines, prune_watermarks, prune_blocked,
+                                summary, round_peers)
         self._bump("sweeps")
         return summary
+
+    def _prune_ledgers(self, engines, prune_watermarks, prune_blocked,
+                       summary, round_peers) -> None:
+        """End-of-sweep ledger pruning (ISSUE 14): drop deletion-ledger
+        version pairs every REGISTERED replica has provably passed.
+        Deliberately all-or-nothing conservative: it runs only when this
+        rank has a group, every peer dial this round succeeded, and no
+        peer is currently suspect — a replica we could not hear from
+        might be missing exactly the delete we would prune, and a
+        resurrected delete is the one failure anti-entropy exists to
+        prevent. Per index it additionally needs a real watermark from
+        every contacted group peer AND matched digests this round
+        (mismatches heal first, prune next round). The min-merge includes
+        our own watermark, so an entry survives until the SLOWEST
+        replica's watermark passes it."""
+        my_group = self.server.shard_group
+        if my_group is None or summary["failed"]:
+            return
+        # suspects scoped to peers STILL in discovery whose group is not
+        # concretely ANOTHER group: a decommissioned address's stale
+        # entry, or a dead node of a different shard group sharing the
+        # discovery file, must not block this group's pruning forever —
+        # but an unknown-group suspect might be an unregistered member
+        # of OURS, so it still blocks
+        if any((s.get("host"), s.get("port")) in round_peers
+               and (s.get("group") is None or s.get("group") == my_group)
+               for s in self.health.suspects()):
+            return
+        for index_id, engine in engines.items():
+            if index_id in prune_blocked:
+                continue
+            with self.server.indexes_lock:
+                # the engines dict is a sweep-start snapshot: an index
+                # dropped (or swapped by a sync) mid-sweep must not get
+                # its tombstone sidecar rewritten by a retired engine —
+                # the exact on-disk resurrection drop_index+retire exist
+                # to prevent
+                if (index_id in self.server._dropped
+                        or self.server.indexes.get(index_id) is not engine):
+                    continue
+            own = _versions.version_key(engine.version_watermark())
+            if own is None:
+                continue
+            floor = min(prune_watermarks.get(index_id, ()) + [own])
+            pruned = engine.prune_ledger(
+                floor, min_age_s=self.cfg.ledger_prune_age_s)
+            if pruned:
+                self._bump("ledger_pruned", pruned)
+                logger.info(
+                    "anti-entropy: pruned %d deletion-ledger version "
+                    "pairs on %r (cluster watermark floor %s)",
+                    pruned, index_id, list(floor))
 
     def _heal(self, index_id: str, engine, host: str, port: int) -> dict:
         """Pull this rank's missing state for one index from one peer.
@@ -456,6 +561,11 @@ class AntiEntropySweeper:
                     self._bump("full_syncs")
                     full = True
                 else:
+                    # hashed exports need a hash-capable peer; the first
+                    # unexpected-keyword rejection degrades the rest of
+                    # this heal to the bare 3-tuple (PR-12 peers)
+                    hash_state = {"supported": True}
+
                     def pull(batch):
                         # rows the peer actually RETURNED (an id deleted
                         # on the peer between id_sets and this pull
@@ -465,10 +575,9 @@ class AntiEntropySweeper:
                         for i in range(0, len(batch), _DELTA_CHUNK):
                             chunk = batch[i:i + _DELTA_CHUNK]
                             if peer_versioned:
-                                emb, meta, vers = peer.generic_fun(
-                                    "export_rows_versioned",
-                                    (index_id, chunk),
-                                    timeout=_HEAL_CALL_TIMEOUT_S)
+                                emb, meta, vers = self._fetch_chunk_verified(
+                                    peer, index_id, chunk, host, port,
+                                    hash_state)
                             else:
                                 emb, meta = peer.generic_fun(
                                     "export_rows", (index_id, chunk),
@@ -523,6 +632,53 @@ class AntiEntropySweeper:
             peer.close()
         return {"removed": removed, "pulled": pulled,
                 "refreshed": refreshed, "full_sync": full}
+
+    def _fetch_chunk_verified(self, peer, index_id: str, chunk,
+                              host: str, port: int, hash_state: dict):
+        """One versioned delta-chunk fetch with content-hash verification
+        (ISSUE 14): the peer's ``export_rows_versioned(with_hash=True)``
+        response carries a sha256 over the row payload planes, recomputed
+        here over what actually ARRIVED before any row is applied. A
+        mismatch is transport corruption: counted
+        (``chunk_hash_mismatch``), the chunk refetched once, and a second
+        mismatch raised as ``rpc.FrameError`` — TRANSPORT_ERRORS, so the
+        sweep's heal handler marks the peer failed instead of installing
+        corrupt rows as repaired state. A pre-hash (PR-12) peer rejects
+        the keyword with an application error; the heal degrades to the
+        unverified 3-tuple for that peer (``hash_state``), preserving the
+        rolling-upgrade contract."""
+        if not hash_state.get("supported"):
+            return peer.generic_fun("export_rows_versioned",
+                                    (index_id, chunk),
+                                    timeout=_HEAL_CALL_TIMEOUT_S)
+        for _attempt in range(2):
+            try:
+                out = peer.generic_fun(
+                    "export_rows_versioned", (index_id, chunk),
+                    {"with_hash": True}, timeout=_HEAL_CALL_TIMEOUT_S)
+            except rpc.ServerException as e:
+                if not ("unexpected keyword argument" in str(e)
+                        and "with_hash" in str(e)):
+                    raise
+                logger.warning(
+                    "anti-entropy: peer %s:%d does not speak hashed row "
+                    "exports; pulling unverified (upgrade the peer to "
+                    "restore content-hash verification)", host, port)
+                hash_state["supported"] = False
+                return peer.generic_fun("export_rows_versioned",
+                                        (index_id, chunk),
+                                        timeout=_HEAL_CALL_TIMEOUT_S)
+            emb, meta, vers, digest = out
+            if serialization.row_payload_hash(emb, meta, vers) == digest:
+                return emb, meta, vers
+            self._bump("chunk_hash_mismatch")
+            logger.warning(
+                "anti-entropy: row-chunk content hash mismatch from "
+                "%s:%d on %r (%d ids); refetching", host, port, index_id,
+                len(chunk))
+        raise rpc.FrameError(
+            f"row-chunk content hash mismatch from {host}:{port} on "
+            f"{index_id!r} after retry — not applying the pull")
 
     # ------------------------------------------------------ compaction lease
 

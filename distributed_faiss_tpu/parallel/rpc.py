@@ -46,6 +46,7 @@ import time
 import numpy as np
 
 from distributed_faiss_tpu.observability import spans as obs_spans
+from distributed_faiss_tpu.parallel import wire
 from distributed_faiss_tpu.utils import envutil, lockdep
 from distributed_faiss_tpu.utils.tracing import LatencyStats
 
@@ -167,6 +168,34 @@ KIND_SHARD_DATA = 9
 KIND_DIGEST = 10
 KIND_DIGEST_RESP = 11
 
+# ------------------------------------------------------------ binary wire
+#
+# Kind-byte flag bit: a frame whose kind carries WIRE_BINARY_FLAG holds a
+# compact BINARY skeleton (parallel/wire.py) instead of pickle bytes —
+# same header, same raw tensor planes, only the skeleton encoding
+# changes. KIND_* wire values must therefore stay below 0x80 (graftlint's
+# frame-protocol checker enforces it). Negotiation is per connection and
+# zero-RTT, riding the protocol's existing extensible halves instead of
+# new frame kinds a legacy peer would choke on:
+#
+#   client -> server: every pickle CALL frame from a wire-capable mux
+#     client carries {"wire": 1} in its meta dict ("I decode binary
+#     frames"). A legacy server ignores unknown meta keys (the documented
+#     compat contract); a wire-capable server marks the CONNECTION
+#     capable and answers search-family responses with binary skeletons
+#     from the very first reply.
+#   server -> client: the first binary-flagged response a stub's demux
+#     receives proves the server speaks binary; subsequent search CALLs
+#     on that connection go out with binary skeletons. The state resets
+#     with the connection (a redial may reach a downgraded peer).
+#
+# Control ops, legacy peers, the serial (mux=False) client, and
+# DFT_RPC_WIRE=pickle all keep the pickle skeletons; any payload outside
+# the binary schema falls back to pickle PER FRAME (wire.WireEncodeError
+# is the fallback signal, never an error on the wire).
+WIRE_BINARY_FLAG = 0x80
+WIRE_META_KEY = "wire"
+
 # untagged kind -> its tagged variant (and back), for servers writing
 # req_id-tagged responses and the client-side demux unwrapping them
 MUX_RESPONSE_KINDS = {
@@ -183,6 +212,19 @@ def mux_enabled_by_env() -> bool:
     """DFT_RPC_MUX master switch (default on): 0 restores the serial
     one-call-per-connection client (the pre-mux A/B arm)."""
     return envutil.env_flag("DFT_RPC_MUX", True)
+
+
+def wire_binary_by_env() -> bool:
+    """DFT_RPC_WIRE master switch (default ``binary``): ``pickle``
+    disables binary-skeleton negotiation on this end entirely — frames
+    stay byte-identical to the pre-wire protocol (the A/B arm and the
+    conservative setting for mixed fleets mid-rollout). ONE parser for
+    both ends: routed through ``WireCfg`` (the same schema the server
+    reads), so an unknown value fails fast identically everywhere
+    instead of crashing servers while clients silently pick binary."""
+    from distributed_faiss_tpu.utils.config import WireCfg
+
+    return WireCfg.from_env().encoding == "binary"
 
 
 # kernel-level bound on a single zero-progress frame write, applied to
@@ -367,10 +409,10 @@ def _send_parts(sock: socket.socket, parts) -> None:
         sock.sendall(p)
 
 
-def pack_frame(kind: int, obj=None):
-    arrays = []
-    skel = pickle.dumps(_extract(obj, arrays), protocol=4)
-    parts = [_HDR.pack(MAGIC, kind, len(skel), len(arrays)), skel]
+def _tensor_parts(arrays):
+    """The raw-buffer plane section shared by BOTH skeleton encodings:
+    per plane ``dtype_len u8 | dtype | ndim u8 | dims u64* | data``."""
+    parts = []
     for a in arrays:
         dt = a.dtype.str.encode()
         hdr = struct.pack("<B", len(dt)) + dt + struct.pack("<B", a.ndim) + struct.pack(
@@ -382,6 +424,13 @@ def pack_frame(kind: int, obj=None):
     return parts
 
 
+def pack_frame(kind: int, obj=None):
+    arrays = []
+    skel = pickle.dumps(_extract(obj, arrays), protocol=4)
+    return [_HDR.pack(MAGIC, kind, len(skel), len(arrays)), skel] \
+        + _tensor_parts(arrays)
+
+
 def send_frame(sock: socket.socket, kind: int, obj=None) -> None:
     _send_parts(sock, pack_frame(kind, obj))
 
@@ -390,6 +439,49 @@ def pack_tagged_response(base_kind: int, obj, req_id: int):
     """Frame parts for a req_id-tagged response: the tagged variant of
     ``base_kind`` (RESULT/ERROR/BUSY) carrying ``({"req_id": n}, obj)``."""
     return pack_frame(MUX_RESPONSE_KINDS[base_kind], ({"req_id": int(req_id)}, obj))
+
+
+def pack_binary_call(fname: str, args, kwargs, meta):
+    """Frame parts for a binary-skeleton CALL, or None when the call
+    falls outside the encodable schema (the caller packs the pickle
+    skeleton instead — the per-frame fallback)."""
+    try:
+        skel, arrays = wire.encode_call(fname, args, kwargs, meta)
+    except wire.WireEncodeError:
+        return None
+    return [_HDR.pack(MAGIC, KIND_CALL | WIRE_BINARY_FLAG,
+                      len(skel), len(arrays)), skel] + _tensor_parts(arrays)
+
+
+_WIRE_ENCODERS = {
+    KIND_RESULT: wire.encode_result,
+    KIND_ERROR: wire.encode_error,
+    KIND_BUSY: wire.encode_busy,
+}
+_WIRE_DECODERS = {
+    KIND_RESULT: wire.decode_result,
+    KIND_ERROR: wire.decode_error,
+    KIND_BUSY: wire.decode_busy,
+}
+
+
+def pack_binary_response(base_kind: int, obj, req_id=None):
+    """Frame parts for a binary-skeleton response (tagged when ``req_id``
+    is given), or None for payloads outside the schema (the caller falls
+    back to the pickle skeleton for that one frame)."""
+    enc = _WIRE_ENCODERS.get(base_kind)
+    if enc is None:
+        return None
+    try:
+        skel, arrays = enc(obj)
+    except wire.WireEncodeError:
+        return None
+    kind = base_kind
+    if req_id is not None:
+        kind = MUX_RESPONSE_KINDS[base_kind]
+        skel = struct.pack("<Q", int(req_id)) + skel
+    return [_HDR.pack(MAGIC, kind | WIRE_BINARY_FLAG,
+                      len(skel), len(arrays)), skel] + _tensor_parts(arrays)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> memoryview:
@@ -404,16 +496,31 @@ def _recv_exact(sock: socket.socket, n: int) -> memoryview:
     return view
 
 
-def recv_frame(sock: socket.socket):
+def recv_frame_ex(sock: socket.socket):
+    """``(kind, payload, was_binary)`` for one frame. Tensor planes land
+    in freshly allocated arrays via ``recv_into`` — straight from the
+    socket into the buffer the caller consumes, no further copy — for
+    BOTH skeleton encodings; only the skeleton decode differs (binary
+    layout vs pickle through the restricted unpickler). ``was_binary``
+    is the client demux's negotiation signal (the peer speaks binary)."""
     head = _recv_exact(sock, _HDR.size)
     magic, kind, skel_len, narr = _HDR.unpack(head)
     if magic != MAGIC:
         raise FrameError(f"bad frame magic {bytes(magic)!r}")
-    skel = restricted_loads(_recv_exact(sock, skel_len))
+    binary = bool(kind & WIRE_BINARY_FLAG)
+    kind &= ~WIRE_BINARY_FLAG
+    skel_bytes = _recv_exact(sock, skel_len)
     arrays = []
     for _ in range(narr):
         (dt_len,) = struct.unpack("<B", _recv_exact(sock, 1))
-        dt = np.dtype(bytes(_recv_exact(sock, dt_len)).decode())
+        try:
+            dt = np.dtype(bytes(_recv_exact(sock, dt_len)).decode())
+        except (TypeError, ValueError, UnicodeDecodeError) as e:
+            # a garbled plane header (desynced/corrupted stream) is a
+            # transport fault: FrameError keeps it inside
+            # TRANSPORT_ERRORS so retry/reroute/teardown handle it,
+            # instead of a bare TypeError escaping the retry machinery
+            raise FrameError(f"undecodable tensor plane header: {e}") from e
         (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
         dims = struct.unpack(f"<{ndim}Q", _recv_exact(sock, 8 * ndim))
         nbytes = int(np.prod(dims, dtype=np.int64)) * dt.itemsize if ndim else dt.itemsize
@@ -427,7 +534,47 @@ def recv_frame(sock: socket.socket):
                     raise EOFError("connection closed mid-tensor")
                 got += r
         arrays.append(a)
-    return kind, _restore(skel, arrays)
+    if not binary:
+        return kind, _restore(restricted_loads(skel_bytes), arrays), False
+    try:
+        # the memoryview passes through undecoded — the codec's reader
+        # slices it in place (only short string fields pay a bytes()
+        # copy), so a large inline labels block costs no skeleton memcpy
+        payload = _decode_binary_skeleton(kind, skel_bytes, arrays)
+    except Exception as e:
+        # a garbled/truncated binary skeleton is corruption or desync:
+        # FrameError keeps it inside TRANSPORT_ERRORS so the connection
+        # is dropped and retry/reroute handle it like a garbled pickle
+        raise FrameError(
+            f"undecodable binary skeleton (kind {kind}): {e}") from e
+    return kind, payload, True
+
+
+def recv_frame(sock: socket.socket):
+    kind, payload, _binary = recv_frame_ex(sock)
+    return kind, payload
+
+
+def _decode_binary_skeleton(kind: int, skel: bytes, arrays):
+    """Decode a binary skeleton into the exact payload shape the pickle
+    path produces for the same kind (tagged kinds included), so every
+    consumer downstream of the frame layer is shared."""
+    if kind == KIND_CALL:
+        return wire.decode_call(skel, arrays)
+    base, req_id = _MUX_TO_BASE.get(kind), None
+    if base is not None:
+        if len(skel) < 8:
+            raise wire.WireDecodeError("tagged skeleton shorter than req_id")
+        (req_id,) = struct.unpack_from("<Q", skel)
+        skel = skel[8:]
+        kind = base
+    dec = _WIRE_DECODERS.get(kind)
+    if dec is None:
+        raise wire.WireDecodeError(f"kind {kind} has no binary schema")
+    body = dec(skel, arrays)
+    if req_id is None:
+        return body
+    return {"req_id": req_id}, body
 
 
 class _PendingCall:
@@ -483,12 +630,24 @@ class Client:
     DEADLINE_GRACE = 0.5
 
     def __init__(self, client_id: int, host: str, port: int, v6: bool = False,
-                 connect_timeout: float = 60.0, mux: bool = None):
+                 connect_timeout: float = 60.0, mux: bool = None,
+                 wire_binary: bool = None):
         self.id = client_id
         self.host = host
         self.port = port
         self._fam = socket.AF_INET6 if v6 else socket.AF_INET
         self._mux = mux_enabled_by_env() if mux is None else bool(mux)
+        # binary-wire negotiation (DFT_RPC_WIRE): the mux client
+        # advertises binary-skeleton capability in its CALL meta and
+        # switches the hot search frames to binary once the peer answers
+        # in kind. The serial client never negotiates — it IS the legacy
+        # dialect (and the byte-identity A/B arm).
+        self._wire = ((wire_binary_by_env() if wire_binary is None
+                       else bool(wire_binary)) and self._mux)
+        # True once THIS connection received a binary-flagged frame
+        # (under _lock, reset per connection): the peer provably decodes
+        # and produces binary skeletons, so search CALLs may go binary
+        self._peer_wire = False
         self._lock = lockdep.lock("Client._lock")
         self._closed = False
         self._shutdown = False
@@ -552,6 +711,7 @@ class Client:
         self._epoch += 1
         self._last_rx = time.monotonic()  # a fresh connection counts as live
         self._peer_tagged = None  # a restarted peer may speak another dialect
+        self._peer_wire = False  # ... including a pickle-only one
         if self._mux:
             self._reader = threading.Thread(
                 target=self._reader_loop, args=(self.sock, self._epoch),
@@ -569,7 +729,7 @@ class Client:
         failure tears the connection down, failing every in-flight call."""
         try:
             while True:
-                kind, payload = recv_frame(sock)
+                kind, payload, was_binary = recv_frame_ex(sock)
                 base = _MUX_TO_BASE.get(kind)
                 tagged = base is not None
                 if tagged:
@@ -582,6 +742,11 @@ class Client:
                         return  # superseded by a redial/teardown
                     self._last_rx = time.monotonic()
                     self._peer_tagged = tagged
+                    if was_binary:
+                        # the peer produced a binary skeleton: it decodes
+                        # them too — search CALLs on this connection may
+                        # now go out binary
+                        self._peer_wire = True
                     if rid is None:
                         rid = next(iter(self._pending), None)
                     slot = self._pending.pop(rid, None)
@@ -678,12 +843,20 @@ class Client:
             self._ensure_connected_locked()
             epoch = self._epoch
             sock = self.sock
+            peer_wire = self._wire and self._peer_wire
         # budget is computed HERE — after any redial wait — so the stamped
         # value reflects what genuinely remains of the caller's deadline
         budget = None
         wait = timeout
         rid = next(self._req_counter)
         meta = {"req_id": rid}
+        if self._wire:
+            # capability advert ("I decode binary frames"): a wire-capable
+            # server starts answering the search family with binary
+            # skeletons; a legacy server ignores the key (the documented
+            # extensible-meta contract). DFT_RPC_WIRE=pickle removes even
+            # this, keeping frames byte-identical to the pre-wire client.
+            meta["wire"] = 1
         if trace_id is not None:
             meta["trace_id"] = trace_id  # spans.TRACE_META_KEY pins this spelling
         if deadline is not None:
@@ -702,7 +875,14 @@ class Client:
         # connection — zero bytes have hit the wire.
         if trace_id is not None:
             w0, p0 = time.time(), time.perf_counter()
-        parts = pack_frame(KIND_CALL, (fname, tuple(args), kwargs or {}, meta))
+        parts = None
+        if peer_wire:
+            # negotiated binary skeleton for the hot search frames; None
+            # (schema miss: unknown op/kwargs/meta) falls back to pickle
+            # for THIS frame only
+            parts = pack_binary_call(fname, tuple(args), kwargs or {}, meta)
+        if parts is None:
+            parts = pack_frame(KIND_CALL, (fname, tuple(args), kwargs or {}, meta))
         if trace_id is not None:
             obs_spans.local_buffer().record(
                 trace_id, "client.pack", w0, time.perf_counter() - p0,
@@ -884,8 +1064,11 @@ class Client:
         with self._lock:
             in_flight = len(self._pending)
             peak = self._inflight_peak
+            peer_wire = self._peer_wire
         return {
             "mux": self._mux,
+            "wire": "binary" if self._wire else "pickle",
+            "peer_wire": peer_wire,
             "in_flight": in_flight,
             "in_flight_peak": peak,
             "round_trip_s": self.stats.summary().get("round_trip_s", {}),

@@ -34,7 +34,7 @@ import numpy as np
 from distributed_faiss_tpu.engine import Index
 from distributed_faiss_tpu.observability import export as obs_export
 from distributed_faiss_tpu.observability import spans as obs_spans
-from distributed_faiss_tpu.parallel import antientropy, rpc
+from distributed_faiss_tpu.parallel import antientropy, rpc, wire
 from distributed_faiss_tpu.serving.scheduler import (
     DeadlineExpired,
     SchedulerBusy,
@@ -47,6 +47,7 @@ from distributed_faiss_tpu.utils.config import (
     IndexCfg,
     SchedulerCfg,
     TracingCfg,
+    WireCfg,
 )
 from distributed_faiss_tpu.utils.state import IndexState
 from distributed_faiss_tpu.utils.tracing import LatencyStats
@@ -76,12 +77,30 @@ def setup_server_logging(level=logging.INFO) -> None:
     )
 
 
+class _ConnState:
+    """Per-connection serving state shared by both loops: the response
+    write lock (mux responses are written by whichever thread completes
+    the call) and the negotiated binary-wire capability. ``peer_wire``
+    flips once the connection's client advertises binary-skeleton
+    decoding (the ``wire`` CALL-meta key, or a binary frame itself) — a
+    per-connection property that dies with the connection, exactly like
+    the client-side half (rpc.Client._peer_wire)."""
+
+    __slots__ = ("addr", "wlock", "peer_wire")
+
+    def __init__(self, addr, wlock):
+        self.addr = addr
+        self.wlock = wlock
+        self.peer_wire = False
+
+
 class IndexServer:
     def __init__(self, rank: int, index_storage_dir: str,
                  scheduler_cfg: Optional[SchedulerCfg] = None,
                  discovery_path: Optional[str] = None,
                  antientropy_cfg: Optional[AntiEntropyCfg] = None,
-                 tracing_cfg: Optional[TracingCfg] = None):
+                 tracing_cfg: Optional[TracingCfg] = None,
+                 wire_cfg: Optional[WireCfg] = None):
         self.indexes: Dict[str, Index] = {}
         self.indexes_lock = lockdep.lock("IndexServer.indexes_lock")
         # index-level drop tombstones: ids this rank has dropped, so the
@@ -138,6 +157,13 @@ class IndexServer:
         # per-connection write lock — many calls in flight per connection,
         # out-of-order completion. Legacy (no-req_id) frames keep the
         # synchronous in-order path.
+        # binary wire (parallel/wire.py): search-family responses to a
+        # connection whose client advertised binary decoding go out with
+        # binary skeletons instead of pickle. DFT_RPC_WIRE=pickle keeps
+        # every response byte-identical to the pre-wire protocol.
+        self._wire_enabled = (
+            (wire_cfg if wire_cfg is not None else WireCfg.from_env())
+            .encoding == "binary")
         self._rpc_worker_count = rpc_worker_count()
         self._rpc_workers = ThreadPoolExecutor(
             max_workers=self._rpc_worker_count,
@@ -442,12 +468,17 @@ class IndexServer:
         peer's delta repair. The pre-version 2-tuple wire shape."""
         return self._get_index(index_id).export_rows(ids)
 
-    def export_rows_versioned(self, index_id: str, ids) -> Tuple:
+    def export_rows_versioned(self, index_id: str, ids,
+                              with_hash: bool = False) -> Tuple:
         """Versioned delta pull: (embeddings, metadata, versions) — the
         puller applies rows through the engine's LWW add gates. A
         separate op (not a changed return shape) so pre-version sweepers
-        calling ``export_rows`` keep working unchanged."""
-        return self._get_index(index_id).export_rows_versioned(ids)
+        calling ``export_rows`` keep working unchanged. ``with_hash``
+        (ISSUE 14) appends a per-chunk sha256 over the row payload as a
+        4th element — the pulling sweeper verifies it before applying;
+        default off keeps the PR-12 3-tuple wire shape."""
+        return self._get_index(index_id).export_rows_versioned(
+            ids, with_hash=with_hash)
 
     # --------------------------------------------------- generation-pinned reads
 
@@ -490,10 +521,18 @@ class IndexServer:
                        if want is None or iid in want}
             held = (self._antientropy.may_compact()
                     if self._antientropy is not None else True)
+            # per-index newest incorporated version: the peer's sweeper
+            # min-merges these across the whole group to prune deletion-
+            # ledger version pairs every replica has passed (pre-prune
+            # peers simply ignore the key)
+            watermarks = {iid: idx.version_watermark()
+                          for iid, idx in snapshot
+                          if want is None or iid in want}
             resp = {
                 "rank": self.rank,
                 "shard_group": self.shard_group,
                 "digests": digests,
+                "watermarks": watermarks,
                 "compaction": {"held": held},
             }
             parts = rpc.pack_frame(rpc.KIND_DIGEST_RESP, resp)
@@ -552,6 +591,9 @@ class IndexServer:
             out["rpc"] = {"in_flight": self._mux_inflight,
                           **self._mux_counters}
         out["rpc"]["workers"] = self._rpc_worker_count
+        # negotiated wire encoding this rank is WILLING to speak (actual
+        # use is per connection — a legacy peer stays on pickle)
+        out["rpc"]["wire"] = "binary" if self._wire_enabled else "pickle"
         # replica identity: which logical shard group this rank serves —
         # the client merges its fan-out counters in under
         # ``replication.client`` (parallel/replication.py)
@@ -734,10 +776,10 @@ class IndexServer:
         # whichever thread completes the call (scheduler batcher via the
         # worker pool, or a worker running a direct op), so frame writes
         # must be serialized against each other and the sync path
-        wlock = lockdep.lock("IndexServer.conn_wlock")
+        state = _ConnState(addr, lockdep.lock("IndexServer.conn_wlock"))
         try:
             while True:
-                self._one_call(conn, wlock=wlock)
+                self._one_call(conn, state=state)
         except (rpc.ClientExit, EOFError):
             pass
         except OSError as e:
@@ -753,8 +795,14 @@ class IndexServer:
                 pass
 
     def _one_call(self, conn: socket.socket, eager_search: bool = False,
-                  wlock: Optional[threading.Lock] = None) -> None:
-        kind, payload = rpc.recv_frame(conn)
+                  state: Optional[_ConnState] = None) -> None:
+        if state is None:
+            # direct callers (tests, single-shot tools): a throwaway
+            # per-call state keeps every dispatch path uniform — the mux
+            # response writers dereference state unconditionally
+            state = _ConnState(None, lockdep.lock("IndexServer.conn_wlock"))
+        kind, payload, was_binary = rpc.recv_frame_ex(conn)
+        wlock = state.wlock
         if kind == rpc.KIND_CLOSE:
             raise rpc.ClientExit("client closed")
         if kind == rpc.KIND_SHARD_FETCH:
@@ -792,6 +840,11 @@ class IndexServer:
                 deadline = time.monotonic() + float(frame_meta["deadline_s"])
             req_id = frame_meta.get("req_id")
             trace_id = frame_meta.get("trace_id")
+            if was_binary or frame_meta.get("wire"):
+                # the peer decodes binary skeletons (explicit advert, or
+                # it just SENT one): search-family responses on this
+                # connection may go out binary from here on
+                state.peer_wire = True
         if req_id is None:
             with self._mux_lock:
                 self._mux_counters["legacy_calls"] += 1
@@ -806,12 +859,12 @@ class IndexServer:
             self._mux_inflight += 1
         t0 = time.perf_counter()
         if fname == "search" and self.scheduler is not None:
-            self._dispatch_scheduled(conn, wlock, args, kwargs, deadline,
+            self._dispatch_scheduled(conn, state, args, kwargs, deadline,
                                      req_id, t0, trace_id)
         else:
             try:
                 self._rpc_workers.submit(
-                    self._dispatch_direct, conn, wlock, fname, args, kwargs,
+                    self._dispatch_direct, conn, state, fname, args, kwargs,
                     req_id, t0, trace_id)
             except RuntimeError:  # pool already shut down (server stopping)
                 with self._mux_lock:
@@ -937,7 +990,7 @@ class IndexServer:
 
     # ------------------------------------------------------------ mux dispatch
 
-    def _dispatch_scheduled(self, conn, wlock, args, kwargs, deadline,
+    def _dispatch_scheduled(self, conn, state, args, kwargs, deadline,
                             req_id, t0, trace_id=None) -> None:
         """Hand a mux search to the scheduler without blocking the reader:
         the scheduler already completes out of order via per-request
@@ -949,7 +1002,7 @@ class IndexServer:
 
         def done(result, error):
             try:
-                self._rpc_workers.submit(self._finish_scheduled, conn, wlock,
+                self._rpc_workers.submit(self._finish_scheduled, conn, state,
                                          req_id, result, error, t0, trace_id)
             except RuntimeError:
                 # pool already shut down (server stopping): the client's
@@ -970,29 +1023,29 @@ class IndexServer:
         except Exception as e:
             # admission rejected (BUSY/deadline/stopped) or bad args:
             # answered synchronously — the request was never queued
-            self._finish_scheduled(conn, wlock, req_id, None, e, t0, trace_id)
+            self._finish_scheduled(conn, state, req_id, None, e, t0, trace_id)
 
-    def _finish_scheduled(self, conn, wlock, req_id, result, error,
+    def _finish_scheduled(self, conn, state, req_id, result, error,
                           t0, trace_id=None) -> None:
         if error is None:
             self.perf.record("search", time.perf_counter() - t0,
                              exemplar=trace_id)
-            self._send_mux_response(conn, wlock, rpc.KIND_RESULT, result,
+            self._send_mux_response(conn, state, rpc.KIND_RESULT, result,
                                     req_id, "search", trace_id)
             return
         busy = self._classify_scheduler_reject(error)
         if busy is not None:
             self.perf.record(busy[0], time.perf_counter() - t0)
-            self._send_mux_response(conn, wlock, rpc.KIND_BUSY, busy[1],
+            self._send_mux_response(conn, state, rpc.KIND_BUSY, busy[1],
                                     req_id, "search", trace_id)
             return
         tb = "".join(traceback.format_exception(
             type(error), error, error.__traceback__))
         logger.error("exception in scheduled search: %s", tb)
-        self._send_mux_response(conn, wlock, rpc.KIND_ERROR, tb,
+        self._send_mux_response(conn, state, rpc.KIND_ERROR, tb,
                                 req_id, "search", trace_id)
 
-    def _dispatch_direct(self, conn, wlock, fname, args, kwargs, req_id,
+    def _dispatch_direct(self, conn, state, fname, args, kwargs, req_id,
                          t0, trace_id=None) -> None:
         """Worker-pool target for mux non-search ops."""
         try:
@@ -1002,24 +1055,38 @@ class IndexServer:
             ret = fn(*args, **(kwargs or {}))
             self.perf.record(fname, time.perf_counter() - t0,
                              exemplar=trace_id)
-            self._send_mux_response(conn, wlock, rpc.KIND_RESULT, ret,
+            self._send_mux_response(conn, state, rpc.KIND_RESULT, ret,
                                     req_id, fname, trace_id)
         except Exception:
             tb = traceback.format_exc()
             logger.error("exception in %s: %s", fname, tb)
-            self._send_mux_response(conn, wlock, rpc.KIND_ERROR, tb,
+            self._send_mux_response(conn, state, rpc.KIND_ERROR, tb,
                                     req_id, fname, trace_id)
 
-    def _send_mux_response(self, conn, wlock, base_kind, payload, req_id,
+    def _pack_mux_response(self, state, base_kind, payload, req_id, fname):
+        """Frame parts for one tagged response: binary skeleton when the
+        connection negotiated it AND the op is in the binary search
+        family; pickle otherwise (including any payload the binary
+        schema cannot carry — the per-frame fallback)."""
+        if (self._wire_enabled and state.peer_wire
+                and fname in wire.BINARY_CALL_OPS):
+            parts = rpc.pack_binary_response(base_kind, payload, req_id)
+            if parts is not None:
+                return parts
+        return rpc.pack_tagged_response(base_kind, payload, req_id)
+
+    def _send_mux_response(self, conn, state, base_kind, payload, req_id,
                            fname, trace_id=None) -> None:
         """Write one req_id-tagged response frame under the connection's
         write lock. A write failure means the peer is gone — its demux has
         already failed the call client-side, so only log. Called exactly
         once per mux call (every dispatch path funnels here), which is
         what keeps the in-flight gauge honest."""
+        wlock = state.wlock
         try:
             try:
-                parts = rpc.pack_tagged_response(base_kind, payload, req_id)
+                parts = self._pack_mux_response(state, base_kind, payload,
+                                                req_id, fname)
             except Exception:
                 # unpicklable result: answer a structured error instead of
                 # leaving the caller waiting (zero bytes hit the wire yet)
@@ -1085,17 +1152,21 @@ class IndexServer:
                         conn, addr = s.accept()
                     except OSError:
                         continue
-                    # per-connection (addr, write-lock) — the lock
-                    # serializes mux response writes from worker threads
-                    # against each other and the inline legacy path
+                    # per-connection state (addr, write-lock, negotiated
+                    # wire capability) — the lock serializes mux response
+                    # writes from worker threads against each other and
+                    # the inline legacy path
                     rpc.bound_send_timeout(conn)
                     sel.register(conn, selectors.EVENT_READ,
-                                 data=(addr, lockdep.lock("IndexServer.conn_wlock")))
+                                 data=_ConnState(
+                                     addr,
+                                     lockdep.lock("IndexServer.conn_wlock")))
                 else:
                     conn = key.fileobj
-                    addr, wlock = key.data
+                    addr = key.data.addr
                     try:
-                        self._one_call(conn, eager_search=True, wlock=wlock)
+                        self._one_call(conn, eager_search=True,
+                                       state=key.data)
                     except (rpc.ClientExit, EOFError, OSError):
                         sel.unregister(conn)
                         conn.close()

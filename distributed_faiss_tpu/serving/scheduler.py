@@ -125,6 +125,24 @@ class _Request:
         return self.q.shape[0]
 
 
+def _concat_rows(live: List["_Request"], n_rows: int) -> np.ndarray:
+    """Buffer-aware concat for a merged window: allocate the exact array
+    the device launch consumes and copy each request's rows into their
+    slice ONCE. Requests arriving over the binary wire already hold
+    contiguous float32 query planes (the schema pins the dtype, and
+    ``rpc.recv_frame_ex`` decoded them straight off the socket), so this
+    is the only copy between socket and device — there is no per-request
+    intermediate materialize, and a non-f32 straggler (legacy pickle
+    frame from an old peer) converts during its one slice copy instead
+    of in a separate pass."""
+    out = np.empty((n_rows, live[0].q.shape[1]), np.float32)
+    ofs = 0
+    for r in live:
+        out[ofs:ofs + r.rows] = r.q
+        ofs += r.rows
+    return out
+
+
 def _split_rows(value, offsets: List[Tuple[int, int]]):
     """Split one element of a batched search result back per caller.
 
@@ -377,8 +395,7 @@ class SearchScheduler:
                     occupancy_rows=n_rows)
         head = live[0]
         try:
-            qcat = head.q if len(live) == 1 else np.concatenate(
-                [r.q for r in live], axis=0)
+            qcat = head.q if len(live) == 1 else _concat_rows(live, n_rows)
             if traced:
                 # hand the engine a representative trace for the launch
                 # (the whole window IS one device program, so one span

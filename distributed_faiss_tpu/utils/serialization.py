@@ -85,6 +85,48 @@ def sha256_file(path: str, chunk_bytes: int = 1 << 20) -> str:
     return h.hexdigest()
 
 
+def _canon(v):
+    """Canonical form of a metadata value for cross-process hashing:
+    repr() alone is NOT canonical for wire-legal values — set/frozenset
+    iteration order follows per-process string-hash randomization, and
+    np-scalar repr differs across numpy major versions — so containers
+    normalize recursively (sets sort by canonical repr) and non-basic
+    leaves reduce to ``str()`` (stable across numpy 1.x/2.x, unlike
+    repr)."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (tuple, list)):
+        return tuple(_canon(e) for e in v)
+    if isinstance(v, (set, frozenset)):
+        return ("set",) + tuple(sorted((_canon(e) for e in v), key=repr))
+    if isinstance(v, dict):
+        return ("dict",) + tuple(sorted(
+            ((_canon(k), _canon(val)) for k, val in v.items()), key=repr))
+    return str(v)
+
+
+def row_payload_hash(embeddings, metadata, versions) -> str:
+    """Content hash of one anti-entropy row chunk: sha256 over the
+    embedding plane bytes (contiguous float32 — the dtype the pull
+    applies) plus the canonicalized metadata and version lists
+    (``_canon``: process- and numpy-version-independent). Computed by
+    the EXPORTING engine over what it sends
+    (``Index.export_rows_versioned(with_hash=True)``) and re-computed by
+    the pulling sweeper over what it received — a mismatch means the
+    transport corrupted the chunk (or the peer is confused), and the
+    pull must not be applied (parallel/antientropy.py counts it as
+    ``chunk_hash_mismatch`` and treats it as a transport failure). The
+    repair RPCs ride the pickle skeleton, which round-trips the decoded
+    objects exactly, so canonical-equal in means canonical-equal out."""
+    h = hashlib.sha256()
+    a = np.ascontiguousarray(np.asarray(embeddings, np.float32))
+    h.update(str(a.shape).encode("utf-8"))
+    h.update(a.tobytes())
+    h.update(repr([_canon(m) for m in metadata]).encode("utf-8"))
+    h.update(repr([_canon(v) for v in versions]).encode("utf-8"))
+    return h.hexdigest()
+
+
 # ------------------------------------------------------------------- manifests
 
 

@@ -49,3 +49,16 @@ class Client:
         if kind == KIND_ERROR:
             raise RuntimeError(payload)
         raise RuntimeError(f"unexpected frame kind {kind}")
+
+
+# ------------------------------------------------- binary wire (clean)
+
+WIRE_BINARY_FLAG = 0x80  # no KIND_* value carries this bit
+
+BINARY_CALL_OPS = ("search",)  # served by the paired Server.search
+
+
+def restricted_loads(data):
+    import pickle
+
+    return pickle.loads(data)  # the ONE sanctioned pickle decode site

@@ -21,3 +21,6 @@ class Server:
             rpc.send_frame(conn, rpc.KIND_RESULT, ret)
         except Exception as e:
             rpc.send_frame(conn, rpc.KIND_ERROR, str(e))
+
+    def search(self, index_id, query, top_k):
+        return (query, [], None)

@@ -1,0 +1,51 @@
+"""frame-protocol known-bad fixture (binary wire): a kind whose value
+collides with the binary-skeleton flag bit, a binary-encodable op the
+paired server does not serve, and a pickle decode outside
+restricted_loads."""
+
+WIRE_BINARY_FLAG = 0x80
+
+KIND_CALL = 0
+KIND_RESULT = 1
+KIND_CLOSE = 2
+KIND_BULK = 0x84  # line 11: collides with the flag bit
+
+BINARY_CALL_OPS = ("search", "export_all")  # line 13: export_all unserved
+
+
+def restricted_loads(data):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def raw_loads(data):
+    import pickle
+
+    return pickle.loads(data)  # line 25: decode outside restricted_loads
+
+
+def send_frame(sock, kind, obj=None):
+    sock.sendall(bytes([kind]))
+
+
+def recv_frame(sock):
+    return sock.recv(1)[0], restricted_loads(sock.recv(64))
+
+
+class Client:
+    def call(self, fname, args, kwargs):
+        send_frame(self.sock, KIND_CALL, (fname, args, kwargs))
+        kind, payload = recv_frame(self.sock)
+        return self._interpret(kind, payload)
+
+    def bulk(self):
+        send_frame(self.sock, KIND_BULK, None)
+
+    def close(self):
+        send_frame(self.sock, KIND_CLOSE, None)
+
+    def _interpret(self, kind, payload):
+        if kind == KIND_RESULT:
+            return payload
+        raise RuntimeError(f"unexpected frame kind {kind}")

@@ -1,0 +1,22 @@
+"""frame-protocol known-bad fixture (binary wire): the paired server —
+serves ``search`` but not the other op the protocol module advertises
+as binary-encodable."""
+
+from tests.fixtures.lint.frameproto_wire_bad import rpc
+
+
+class Server:
+    def _one_call(self, conn):
+        kind, payload = rpc.recv_frame(conn)
+        if kind == rpc.KIND_CLOSE:
+            raise SystemExit
+        if kind == rpc.KIND_BULK:
+            return
+        if kind != rpc.KIND_CALL:
+            raise RuntimeError(f"unexpected frame kind {kind}")
+        fname, args, kwargs = payload
+        ret = getattr(self, fname)(*args, **kwargs)
+        rpc.send_frame(conn, rpc.KIND_RESULT, ret)
+
+    def search(self, index_id, query, top_k):
+        return (query, [], None)

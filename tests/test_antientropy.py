@@ -846,3 +846,273 @@ def test_compaction_watcher_defers_without_lease(tmp_path):
     # explicit operator compact bypasses the lease
     assert eng.compact()
     assert eng._mutation_counters["compactions"] == 1
+
+
+# -------------------------------------------- content-hash verified pulls
+
+
+def test_export_rows_versioned_hash_roundtrip(tmp_path):
+    """with_hash=True appends a sha256 the receiver can recompute over
+    the decoded payload; the bare call keeps the PR-12 3-tuple shape."""
+    from distributed_faiss_tpu.utils import serialization
+
+    eng = make_engine(tmp_path, "h")
+    x = np.random.default_rng(0).standard_normal((12, DIM)).astype(np.float32)
+    eng.add_batch(x, [(i,) for i in range(12)],
+                  train_async_if_triggered=False)
+    wait_for(lambda: drained(eng))
+    bare = eng.export_rows_versioned(list(range(5)))
+    assert len(bare) == 3 and len(bare[1]) == 5
+    emb, meta, vers, digest = eng.export_rows_versioned(
+        list(range(5)), with_hash=True)
+    np.testing.assert_array_equal(emb, bare[0])
+    assert serialization.row_payload_hash(emb, meta, vers) == digest
+    # any payload change breaks the hash
+    assert serialization.row_payload_hash(emb + 1.0, meta, vers) != digest
+    assert serialization.row_payload_hash(emb, meta[:-1], vers) != digest
+    # canonicalization: set-valued metadata hashes by CONTENT, not by
+    # per-process repr order (str-hash randomization), and equal sets
+    # built differently hash equal while different sets differ
+    h1 = serialization.row_payload_hash(
+        emb[:1], [({"a", "b", "c"},)], [None])
+    h2 = serialization.row_payload_hash(
+        emb[:1], [(set(["c", "b", "a"]),)], [None])
+    h3 = serialization.row_payload_hash(
+        emb[:1], [({"a", "b", "z"},)], [None])
+    assert h1 == h2 and h1 != h3
+
+
+def test_heal_rejects_corrupt_chunk_and_marks_peer(tmp_path):
+    """A delta pull whose chunk fails content-hash verification is
+    counted, retried once, NEVER applied, and surfaces as a transport
+    failure feeding the failure detector; with the corruption gone the
+    next sweep heals normally."""
+    pa, pb = free_port(), free_port()
+    disc = str(tmp_path / "disc.txt")
+    with open(disc, "w") as f:
+        f.write(f"2\nlocalhost,{pa}\nlocalhost,{pb}\n")
+    cfg = AntiEntropyCfg(interval_s=600)
+    a = start_server(0, pa, str(tmp_path / "a"), disc, 0, cfg)
+    b = start_server(1, pb, str(tmp_path / "b"), disc, 0, cfg)
+    try:
+        a.create_index("t", flat_cfg())
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((30, DIM)).astype(np.float32)
+        a.add_index_data("t", x, [(i,) for i in range(30)])
+        wait_for(lambda: (a.get_state("t") == IndexState.TRAINED
+                          and a.get_aggregated_ntotal("t") == 0))
+        b._antientropy.sweep_once()  # full-sync B in
+        wait_for(lambda: b.get_aggregated_ntotal("t") == 0)
+
+        # diverge: 6 fresh rows land on A only, and A's export corrupts
+        # the payload while keeping its claimed hash (simulated transport
+        # corruption past the TCP checksum)
+        a.add_index_data("t", x[:6] + 9.0, [(100 + i,) for i in range(6)])
+        wait_for(lambda: a.get_aggregated_ntotal("t") == 0)
+        eng_a = a._get_index("t")
+        orig = eng_a.export_rows_versioned
+
+        def corrupting(ids, with_hash=False):
+            out = orig(ids, with_hash=with_hash)
+            if with_hash:
+                emb, meta, vers, digest = out
+                return emb + 1.0, meta, vers, digest  # payload != hash
+            return out
+
+        eng_a.export_rows_versioned = corrupting
+        before = b.get_ntotal("t")
+        out = b._antientropy.sweep_once()
+        stats = b._antientropy.stats()
+        assert stats["chunk_hash_mismatch"] == 2  # first try + one retry
+        assert b.get_ntotal("t") == before, "corrupt rows were applied"
+        assert out["failed"] >= 1
+        peers = b._antientropy.health.snapshot()
+        assert any(e.get("failures", 0) >= 1 for e in peers.values())
+
+        # corruption clears -> the next sweep heals and verifies clean
+        eng_a.export_rows_versioned = orig
+        b._antientropy.sweep_once()
+        wait_for(lambda: b.get_aggregated_ntotal("t") == 0)
+        assert b._antientropy.stats()["chunk_hash_mismatch"] == 2
+        da = a._get_index("t").replica_digest()
+        db = b._get_index("t").replica_digest()
+        assert digests_match(da, db)
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------- deletion-ledger pruning
+
+
+def test_tombstone_prune_ledger_unit():
+    from distributed_faiss_tpu.mutation.versions import HLC
+
+    clock = HLC(writer_id=1)
+    ts = TombstoneSet()
+    v1, v2, v3 = clock.tick(), clock.tick(), clock.tick()
+    ts.ledger_update_versioned([("a", v1), ("b", v2)])
+    ts.ledger_update(["legacy"])  # version None: never prunable
+    assert ts.prune_ledger(None) == 0
+    assert ts.prune_ledger(v1) == 0          # strictly below only
+    assert ts.prune_ledger(v2) == 1          # drops ("a", v1)
+    assert ts.ledger() == frozenset({"b", "legacy"})
+    assert ts.prune_ledger(v3) == 1          # drops ("b", v2)
+    assert ts.ledger() == frozenset({"legacy"})
+    assert ts.prune_ledger(v3) == 0
+    # the age bound: a below-floor entry YOUNGER than the cutoff
+    # survives (a client's repair queue may still replay the pre-delete
+    # add this pair gates — DFT_LEDGER_PRUNE_AGE_S)
+    v4, v5 = clock.tick(), clock.tick()
+    ts.ledger_update_versioned([("c", v4)])
+    assert ts.prune_ledger(v5, max_wall_ms=v4[0] - 10_000) == 0
+    assert "c" in ts.ledger()
+    assert ts.prune_ledger(v5, max_wall_ms=v4[0]) == 1
+
+
+def test_ledger_prunes_after_cluster_watermark_never_while_suspect(tmp_path):
+    """The sweeper prunes deletion-ledger version pairs once every
+    registered replica's watermark passed them — and NEVER while a group
+    peer is unreachable/suspect this round (a replica we cannot hear
+    from might be missing exactly the delete we would prune). A
+    decommissioned address REMOVED from discovery stops blocking (its
+    stale suspect entry is out of scope)."""
+    from distributed_faiss_tpu.mutation.versions import HLC
+
+    pa, pb, pdead = free_port(), free_port(), free_port()
+    disc = str(tmp_path / "disc.txt")
+    with open(disc, "w") as f:
+        f.write(f"2\nlocalhost,{pa}\nlocalhost,{pb}\n")
+    cfg = AntiEntropyCfg(interval_s=600, suspect_after=1,
+                         exchange_timeout_s=1.0, ledger_prune_age_s=0.0)
+    a = start_server(0, pa, str(tmp_path / "a"), disc, 0, cfg)
+    b = start_server(1, pb, str(tmp_path / "b"), disc, 0, cfg)
+    try:
+        clock = HLC(writer_id=7)
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((20, DIM)).astype(np.float32)
+        for srv in (a, b):
+            srv.create_index("t", flat_cfg())
+            srv.add_index_data("t", x, [(i,) for i in range(20)],
+                               version=clock.tick())
+        wait_for(lambda: all(s.get_state("t") == IndexState.TRAINED
+                             and s.get_aggregated_ntotal("t") == 0
+                             for s in (a, b)))
+        vdel = clock.tick()
+        for srv in (a, b):
+            srv.remove_ids("t", [0, 1, 2], version=vdel)
+        for eng in (a._get_index("t"), b._get_index("t")):
+            assert eng.tombstones.ledger_size() == 3
+        # the delete IS the watermark: nothing is strictly below it yet
+        a._antientropy.sweep_once()
+        assert a._get_index("t").tombstones.ledger_size() == 3
+
+        # a newer write on both replicas moves every watermark past vdel
+        vnew = clock.tick()
+        for srv in (a, b):
+            srv.add_index_data("t", x[:1] + 50.0, [(200,)], version=vnew)
+        wait_for(lambda: all(s.get_aggregated_ntotal("t") == 0
+                             for s in (a, b)))
+
+        # ... but with an UNREACHABLE registered peer in discovery, the
+        # sweep must NOT prune (dial failure -> suspect after 1 miss)
+        with open(disc, "w") as f:
+            f.write(f"3\nlocalhost,{pa}\nlocalhost,{pb}\n"
+                    f"localhost,{pdead}\n")
+        a._antientropy.sweep_once()
+        assert a._get_index("t").tombstones.ledger_size() == 3
+        a._antientropy.sweep_once()  # now suspect-marked: still no prune
+        assert a._get_index("t").tombstones.ledger_size() == 3
+        # the dead address is decommissioned (removed from discovery) but
+        # a LIVE unregistered peer (no shard_group yet — a fresh restart
+        # no client has dialed) joins: it might be a member of OUR
+        # group, so it must block pruning exactly like a failed dial
+        pc = free_port()
+        c = IndexServer(2, str(tmp_path / "c"), discovery_path=disc,
+                        antientropy_cfg=cfg)
+        threading.Thread(target=c.start_blocking, args=(pc,),
+                         daemon=True).start()
+        wait_for(lambda: c.socket is not None)
+        with open(disc, "w") as f:
+            f.write(f"3\nlocalhost,{pa}\nlocalhost,{pb}\nlocalhost,{pc}\n")
+        try:
+            a._antientropy.sweep_once()
+            assert a._get_index("t").tombstones.ledger_size() == 3
+            # ... until it registers into a DIFFERENT group: another
+            # group's replica never blocks ours
+            c.set_shard_group(1)
+            a._antientropy.sweep_once()
+        finally:
+            c.stop()
+            # decommission c before B's own sweep: a dead listed peer
+            # would (correctly) block B's pruning
+            with open(disc, "w") as f:
+                f.write(f"2\nlocalhost,{pa}\nlocalhost,{pb}\n")
+        eng_a = a._get_index("t")
+        assert eng_a.tombstones.ledger_size() == 0
+        assert eng_a.mutation_stats()["ledger_pruned"] == 3
+        assert a._antientropy.stats()["ledger_pruned"] == 3
+        # B prunes from its own sweep
+        b._antientropy.sweep_once()
+        assert b._get_index("t").tombstones.ledger_size() == 0
+        # pruning persisted: the reloaded sidecar stays pruned
+        sets = eng_a.id_sets()
+        assert sets["dead"] == []
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_delete_churn_ledger_stays_bounded(tmp_path):
+    """The ISSUE 14 regression: delete-heavy churn used to grow the
+    sidecar's version-pair ledger without bound; with sweeper-driven
+    pruning the ledger retains only entries at/after the cluster
+    watermark floor."""
+    from distributed_faiss_tpu.mutation.versions import HLC
+
+    pa, pb = free_port(), free_port()
+    disc = str(tmp_path / "disc.txt")
+    with open(disc, "w") as f:
+        f.write(f"2\nlocalhost,{pa}\nlocalhost,{pb}\n")
+    cfg = AntiEntropyCfg(interval_s=600, ledger_prune_age_s=0.0)
+    a = start_server(0, pa, str(tmp_path / "a"), disc, 0, cfg)
+    b = start_server(1, pb, str(tmp_path / "b"), disc, 0, cfg)
+    try:
+        clock = HLC(writer_id=9)
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((10, DIM)).astype(np.float32)
+        for srv in (a, b):
+            srv.create_index("t", flat_cfg())
+            srv.add_index_data("t", x, [(i,) for i in range(10)],
+                               version=clock.tick())
+        wait_for(lambda: all(s.get_state("t") == IndexState.TRAINED
+                             and s.get_aggregated_ntotal("t") == 0
+                             for s in (a, b)))
+        batch, rounds = 8, 5
+        next_id = 1000
+        for _r in range(rounds):
+            ids = list(range(next_id, next_id + batch))
+            next_id += batch
+            vadd = clock.tick()
+            for srv in (a, b):
+                srv.add_index_data("t", rng.standard_normal(
+                    (batch, DIM)).astype(np.float32),
+                    [(i,) for i in ids], version=vadd)
+            wait_for(lambda: all(s.get_aggregated_ntotal("t") == 0
+                                 for s in (a, b)))
+            vdel = clock.tick()
+            for srv in (a, b):
+                srv.remove_ids("t", ids, version=vdel)
+            a._antientropy.sweep_once()
+            b._antientropy.sweep_once()
+        total_deleted = batch * rounds
+        for srv in (a, b):
+            size = srv._get_index("t").tombstones.ledger_size()
+            # without pruning this is total_deleted (40); with it, only
+            # the final round's pairs (nothing newer outranks them yet)
+            # survive
+            assert size <= batch, (size, total_deleted)
+        assert a._antientropy.stats()["ledger_pruned"] > 0
+    finally:
+        a.stop()
+        b.stop()

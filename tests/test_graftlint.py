@@ -189,6 +189,23 @@ def test_frameproto_clean_fixture():
     assert _lint(f"{FIX}/frameproto_clean") == []
 
 
+def test_frameproto_wire_bad_fixture():
+    """The ISSUE 14 binary-wire contract rules: flag-bit collision,
+    unserved binary-encodable op, pickle decode outside
+    restricted_loads."""
+    locs = sorted((f.rule, os.path.basename(f.path), f.line)
+                  for f in _lint(f"{FIX}/frameproto_wire_bad"))
+    assert locs == [
+        ("frame-protocol", "rpc.py", 11),  # KIND value collides with flag
+        ("frame-protocol", "rpc.py", 13),  # BINARY_CALL_OPS op unserved
+        ("frame-protocol", "rpc.py", 25),  # pickle.loads outside the pin
+    ]
+    msgs = {f.line: f.message for f in _lint(f"{FIX}/frameproto_wire_bad")}
+    assert "WIRE_BINARY_FLAG" in msgs[11]
+    assert "export_all" in msgs[13]
+    assert "restricted_loads" in msgs[25]
+
+
 def test_stale_pins_fail_the_repo_lint(monkeypatch):
     """The frame-protocol stale-pin audit: drift in the reviewed PINS map
     (class gone, attribute gone, lock gone) turns into findings anchored

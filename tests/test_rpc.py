@@ -505,8 +505,9 @@ def test_deadline_stamped_as_relative_budget_in_frame():
         (rpc.KIND_RESULT, "ok"),
     ])
     c = rpc.Client(0, "localhost", srv.port)
-    # mux frames always carry the meta element (req_id); deadline_s joins
-    # it only when a deadline is set. A SERIAL (DFT_RPC_MUX=0) client still
+    # mux frames always carry the meta element (req_id, plus the binary-
+    # wire capability advert since ISSUE 14); deadline_s joins it only
+    # when a deadline is set. A SERIAL (DFT_RPC_MUX=0) client still
     # sends legacy 3-tuple frames without a deadline — checked below.
     assert c.generic_fun("ping", ()) == "ok"
     assert c.generic_fun("ping", (), deadline=time.time() + 5.0) == "ok"
@@ -514,7 +515,8 @@ def test_deadline_stamped_as_relative_budget_in_frame():
     while len(srv.frames) < 2 and time.time() < deadline:
         time.sleep(0.01)
     assert len(srv.frames[0]) == 4
-    assert srv.frames[0][3].keys() == {"req_id"}
+    assert srv.frames[0][3].keys() == {"req_id", "wire"}
+    assert srv.frames[0][3]["wire"] == 1
     assert len(srv.frames[1]) == 4
     assert srv.frames[1][3]["req_id"] != srv.frames[0][3]["req_id"]
     budget = srv.frames[1][3]["deadline_s"]

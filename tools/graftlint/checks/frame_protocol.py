@@ -35,6 +35,19 @@ named ``rpc.py`` defining ``KIND_*`` constants, paired with the
   compat contract; the in-repo pair agreeing is this checker's.)
 - **dead kinds** — a kind defined but never referenced again is wiring
   someone forgot to finish;
+- **binary-wire contract** (ISSUE 14; each rule gates on its marker, so
+  pre-wire protocol modules and fixtures stay quiet): (a) no ``KIND_*``
+  wire value may collide with the ``WIRE_BINARY_FLAG`` kind-byte bit —
+  a flagged frame would decode as a DIFFERENT kind on a peer; (b) every
+  op advertised in ``BINARY_CALL_OPS`` (the binary CALL schema registry,
+  in the protocol module or its sibling ``wire.py``) must be a public
+  method the paired server actually serves — an encodable op the
+  dispatch cannot serve is dead wire surface; (c) ``restricted_loads``
+  is pinned as the ONLY pickle decode entry point: any
+  ``pickle.loads`` / ``pickle.load`` / ``pickle.Unpickler`` reference in
+  the protocol module outside ``restricted_loads`` /
+  ``_RestrictedUnpickler`` is a finding (the binary path must never grow
+  a second unpickler, and neither may anything else);
 - **stale pins** — every entry of the lock-discipline ``PINS`` map
   (checks/locks.py, the reviewed allowlist) must resolve: the named
   class exists, the attribute is actually assigned in it, and the lock
@@ -148,6 +161,9 @@ def _check_protocols(model):
                 f"taken by {first_name} — kinds must be unique",
             )
 
+        yield from _check_wire_flag(mod, kinds)
+        yield from _check_pickle_entry(mod)
+
         mux = _mux_map(mod, kinds)
         mux_values = set(mux.values()) if mux else set()
         mux_reported = set()
@@ -237,6 +253,7 @@ def _check_protocols(model):
 
             yield from _check_call_arity(mod, server, kinds, client_cls)
             yield from _check_call_meta(mod, server, client_cls)
+            yield from _check_binary_ops(model, mod, server)
 
         # --- dead kinds -------------------------------------------------
         referenced = set()
@@ -253,6 +270,114 @@ def _check_protocols(model):
                     f"frame kind {name} is defined but never sent, "
                     "dispatched, or registered — dead protocol surface",
                 )
+
+
+def _module_int_const(mod, name):
+    """(value, line) of a module-level ``NAME = <int literal>``, or None."""
+    for stmt in mod.tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == name
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, int)):
+            return stmt.value.value, stmt.lineno
+    return None
+
+
+def _check_wire_flag(mod, kinds):
+    """Binary-wire rule (a): no KIND_* value may carry the
+    WIRE_BINARY_FLAG bit — ``recv`` strips the flag before dispatch, so
+    a colliding kind's frames would decode as a DIFFERENT kind. Gated on
+    the module defining the flag (pre-wire protocols stay quiet)."""
+    flag = _module_int_const(mod, "WIRE_BINARY_FLAG")
+    if flag is None:
+        return
+    flag_value, _flag_line = flag
+    for name, (val, line) in sorted(kinds.items()):
+        if val & flag_value:
+            yield Finding(
+                RULE, mod.relpath, line, 0,
+                f"frame kind {name} wire value {val:#x} collides with the "
+                f"binary-skeleton flag bit WIRE_BINARY_FLAG "
+                f"({flag_value:#x}) — its flagged frames would decode as "
+                "a different kind",
+            )
+
+
+def _check_pickle_entry(mod):
+    """Binary-wire rule (c): ``restricted_loads`` is the ONLY pickle
+    decode entry point in the protocol module. Gated on the module
+    defining ``restricted_loads`` (fixture protocols without the pickle
+    machinery stay quiet). ``pickle.dumps`` (the encode side) and
+    ``pickle.UnpicklingError`` (exception classification) stay legal
+    everywhere."""
+    allowed = []
+    for stmt in mod.tree.body:
+        if (isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and stmt.name == "restricted_loads"):
+            allowed.append(stmt)
+        elif (isinstance(stmt, ast.ClassDef)
+                and stmt.name == "_RestrictedUnpickler"):
+            allowed.append(stmt)
+    if not any(isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+               for n in allowed):
+        return
+    allowed_lines = set()
+    for n in allowed:
+        for sub in ast.walk(n):
+            if hasattr(sub, "lineno"):
+                allowed_lines.add(sub.lineno)
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "pickle"
+                and node.attr in ("loads", "load", "Unpickler")
+                and node.lineno not in allowed_lines):
+            yield Finding(
+                RULE, mod.relpath, node.lineno, 0,
+                f"pickle.{node.attr} outside restricted_loads/"
+                "_RestrictedUnpickler — restricted_loads is pinned as the "
+                "ONLY pickle decode entry point for wire bytes",
+            )
+
+
+def _check_binary_ops(model, mod, server):
+    """Binary-wire rule (b): every op in ``BINARY_CALL_OPS`` (the binary
+    CALL schema registry — in the protocol module or its sibling
+    ``wire.py``) must be a public function the paired server defines:
+    the binary-encodable op set and the server's decode dispatch must
+    stay closed over each other."""
+    mod_dir = os.path.dirname(mod.relpath)
+    candidates = [mod]
+    for m in model.modules:
+        if (os.path.dirname(m.relpath) == mod_dir
+                and os.path.basename(m.relpath) == "wire.py"):
+            candidates.append(m)
+    ops_home, ops, ops_line = None, None, 0
+    for m in candidates:
+        for stmt in m.tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "BINARY_CALL_OPS"
+                    and isinstance(stmt.value, (ast.Tuple, ast.List))):
+                vals = [e.value for e in stmt.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+                ops_home, ops, ops_line = m, vals, stmt.lineno
+                break
+        if ops is not None:
+            break
+    if not ops:
+        return
+    served = {f.name for f in server.functions}
+    for op in ops:
+        if op.startswith("_") or op not in served:
+            yield Finding(
+                RULE, ops_home.relpath, ops_line, 0,
+                f"binary-encodable op {op!r} (BINARY_CALL_OPS) is not a "
+                "public function of the paired server — the binary CALL "
+                "schema advertises an op the decode dispatch cannot serve",
+            )
 
 
 def _check_call_arity(mod, server, kinds, client_cls):
