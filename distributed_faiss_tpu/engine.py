@@ -278,9 +278,11 @@ class Index:
         # state gate), joined best-effort
         self._save_thread: Optional[threading.Thread] = None
         self._compaction_thread: Optional[threading.Thread] = None
+        # graftlint: atomic(_train_thread, _add_thread): transient worker handles — the TRAINING/ADD state gate (taken under index_lock) means concurrent spawners lose the state race before both can start a worker, and retire()'s bounded best-effort join tolerates a superseded handle
         self._train_thread: Optional[threading.Thread] = None
         self._add_thread: Optional[threading.Thread] = None
 
+        # graftlint: atomic(index_save_time): save-interval heuristic — a single float publish the save watcher reads lock-free; a stale read only shifts one autosave by an interval
         self.index_save_time = time.time()
         self.index_saved_size = 0
         # device-launch latency/occupancy distributions, surfaced through
@@ -1780,6 +1782,7 @@ class Index:
                 if meta and p not in dead}
 
     def upd_cfg(self, cfg: IndexCfg) -> None:
+        # graftlint: atomic(cfg): operator-initiated whole-object publish — a reader holds either the old or the new IndexCfg reference, never a torn one; cross-field coherence is not promised across an upd_cfg by design
         self.cfg = cfg
         with self.index_lock:
             if self.tpu_index is not None:

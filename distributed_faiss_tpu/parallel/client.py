@@ -51,6 +51,7 @@ from distributed_faiss_tpu.mutation import versions as _versions
 from distributed_faiss_tpu.observability import spans as obs_spans
 from distributed_faiss_tpu.parallel import replication, rpc
 from distributed_faiss_tpu.utils import envutil, lockdep
+from distributed_faiss_tpu.utils.atomics import AtomicCounters
 from distributed_faiss_tpu.utils.config import (
     IndexCfg,
     ReplicationCfg,
@@ -214,8 +215,11 @@ class IndexClient:
         # caps memory on a long-lived client; see get_perf_stats).
         self._stats_lock = lockdep.lock("IndexClient._stats_lock")
         self.reroutes = deque(maxlen=REROUTE_LOG_LEN)
-        self.counters = {"reroutes": 0, "failovers": 0,
-                         "under_replicated": 0, "quorum_failures": 0}
+        # monotonic fan-out totals ride the shared atomic-counter helper
+        # (utils/atomics.py): worker threads bump them without taking the
+        # stats lock, and stats readers get a torn-free snapshot
+        self.counters = AtomicCounters(
+            ("reroutes", "failovers", "under_replicated", "quorum_failures"))
         # replica-group membership: logical shard group -> stub positions
         # (R=1 degenerates to one group per rank — the pre-replication
         # topology). Built from each rank's registered shard_group with a
@@ -557,8 +561,7 @@ class IndexClient:
                 # for repair and raise instead
                 records = self._record_under_replicated(
                     index_id, gid, failed, embeddings, metadata, version)
-                with self._stats_lock:
-                    self.counters["quorum_failures"] += 1
+                self.counters.inc("quorum_failures")
                 raise QuorumError(index_id, gid, acked, needed, records)
             # the whole group is transport-dead: reroute the batch to the
             # next group (PR 3 semantics, generalized from ranks to groups)
@@ -578,7 +581,7 @@ class IndexClient:
                         "error": f"{type(e).__name__}: {e}",
                         "rerouted_to": next_reps[0] if next_reps else None,
                     })
-                    self.counters["reroutes"] += 1
+                    self.counters.inc("reroutes")
                     last_exc = e
         raise RuntimeError(
             f"add_index_data for {index_id!r} failed on every rank"
@@ -639,8 +642,7 @@ class IndexClient:
             "failures": records,
             **payload,
         })
-        with self._stats_lock:
-            self.counters["under_replicated"] += 1
+        self.counters.inc("under_replicated")
         return records
 
     def _repair_send(self, item: dict, pos: int) -> None:
@@ -914,8 +916,7 @@ class IndexClient:
             records = self._record_repair_op(index_id, gid, failed,
                                              op="remove_ids", ids=ids,
                                              version=version)
-            with self._stats_lock:
-                self.counters["quorum_failures"] += 1
+            self.counters.inc("quorum_failures")
             if quorum_failure is None:
                 quorum_failure = QuorumError(
                     index_id, gid, [p for p, _r in acked], needed, records)
@@ -1099,8 +1100,8 @@ class IndexClient:
             )
 
         def note_failover(group, pos):
+            self.counters.inc("failovers")
             with self._stats_lock:
-                self.counters["failovers"] += 1
                 self._preferred[group] = pos
 
         def note_hop(group, idx, error, att_w0, att_p0):
@@ -1567,7 +1568,11 @@ class IndexClient:
         everything it recorded; only the server-side anti-entropy sweep
         covers the dropped batches."""
         with self._stats_lock:
-            counters = dict(self.counters)
+            # torn-free counter snapshot taken beside the ring/suspect
+            # reads (the counter lock is a leaf: safe under _stats_lock).
+            # Fan-out workers bump the totals lock-free, so the reads are
+            # adjacent, not a cross-field consistency guarantee.
+            counters = self.counters.snapshot()
             recent = len(self.reroutes)
             suspects = sorted(self._suspects)
             unversioned = sorted(self._unversioned_ranks)

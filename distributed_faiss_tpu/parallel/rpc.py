@@ -484,70 +484,144 @@ def pack_binary_response(base_kind: int, obj, req_id=None):
                       len(skel), len(arrays)), skel] + _tensor_parts(arrays)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> memoryview:
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = sock.recv_into(view[got:], n - got)
-        if r == 0:
-            raise EOFError("connection closed mid-frame" if got else "connection closed")
-        got += r
-    return view
+class FrameReader:
+    """Buffered frame reader: ONE ``recv`` typically pulls a frame's
+    header + skeleton + every tensor-plane header (and any already-queued
+    follower frames) into a per-connection buffer, where the old
+    unbuffered path paid 2 syscalls per frame plus 4 per plane for
+    byte-sized header fields. Bulk plane DATA still lands straight off
+    the socket into the freshly allocated array via ``recv_into`` (any
+    buffered prefix is copied out first) — the zero-copy contract is
+    unchanged.
+
+    ``bufsize=0`` disables over-reading: every ``recv`` asks for exactly
+    what the current frame still needs, which is byte-stream-safe for
+    one-shot exchanges on sockets whose later bytes someone else will
+    read (``recv_frame``/``recv_frame_ex`` module functions use this
+    mode). With a positive ``bufsize`` the reader may hold bytes of the
+    NEXT frame between calls — callers owning a connection's whole read
+    side (the demux reader, the serving loops) keep ONE reader per
+    connection and consult ``pending`` before blocking in a selector
+    (buffered bytes make no socket readable).
+
+    Decoded results are byte-identical to the unbuffered reader's
+    (pinned in tests/test_wire.py)."""
+
+    def __init__(self, sock: socket.socket, bufsize: int = 65536):
+        self._sock = sock
+        self._bufsize = max(0, int(bufsize))
+        self._buf = bytearray()
+        self._pos = 0
+        self._frame_started = False
+
+    @property
+    def pending(self) -> bool:
+        """True when already-buffered bytes (the start of a next frame)
+        are waiting — a selector loop must serve them before blocking in
+        ``select`` (they will never make the socket readable)."""
+        return self._pos < len(self._buf)
+
+    def _take(self, n: int) -> memoryview:
+        """The next ``n`` stream bytes out of the buffer (filling it from
+        the socket as needed). The view is only valid until the next
+        ``_take``/``_readinto`` — copy (``bytes``) anything held longer."""
+        while len(self._buf) - self._pos < n:
+            if self._pos and self._pos == len(self._buf):
+                self._buf = bytearray()
+                self._pos = 0
+            want = n - (len(self._buf) - self._pos)
+            data = self._sock.recv(max(want, self._bufsize))
+            if not data:
+                raise EOFError("connection closed mid-frame"
+                               if self._frame_started or self.pending
+                               else "connection closed")
+            self._buf += data
+        out = memoryview(self._buf)[self._pos:self._pos + n]
+        self._pos += n
+        self._frame_started = True
+        return out
+
+    def _readinto(self, view: memoryview) -> None:
+        """Fill ``view`` with the next stream bytes: buffered prefix
+        first, then ``recv_into`` DIRECTLY into the destination (bulk
+        tensor bytes never transit the buffer)."""
+        n = len(view)
+        got = min(len(self._buf) - self._pos, n)
+        if got:
+            view[:got] = memoryview(self._buf)[self._pos:self._pos + got]
+            self._pos += got
+        while got < n:
+            r = self._sock.recv_into(view[got:], n - got)
+            if r == 0:
+                raise EOFError("connection closed mid-tensor")
+            got += r
+
+    def recv_frame_ex(self):
+        """``(kind, payload, was_binary)`` for one frame. Tensor planes
+        land in freshly allocated arrays via ``recv_into`` — straight
+        from the socket into the buffer the caller consumes, no further
+        copy — for BOTH skeleton encodings; only the skeleton decode
+        differs (binary layout vs pickle through the restricted
+        unpickler). ``was_binary`` is the client demux's negotiation
+        signal (the peer speaks binary)."""
+        self._frame_started = False
+        magic, kind, skel_len, narr = _HDR.unpack(self._take(_HDR.size))
+        if magic != MAGIC:
+            raise FrameError(f"bad frame magic {bytes(magic)!r}")
+        binary = bool(kind & WIRE_BINARY_FLAG)
+        kind &= ~WIRE_BINARY_FLAG
+        # the skeleton outlives the plane reads below (which refill the
+        # buffer), so it pays the one copy out of the recv buffer here
+        skel_bytes = bytes(self._take(skel_len))
+        arrays = []
+        for _ in range(narr):
+            (dt_len,) = struct.unpack("<B", self._take(1))
+            try:
+                dt = np.dtype(bytes(self._take(dt_len)).decode())
+            except (TypeError, ValueError, UnicodeDecodeError) as e:
+                # a garbled plane header (desynced/corrupted stream) is a
+                # transport fault: FrameError keeps it inside
+                # TRANSPORT_ERRORS so retry/reroute/teardown handle it,
+                # instead of a bare TypeError escaping the retry machinery
+                raise FrameError(
+                    f"undecodable tensor plane header: {e}") from e
+            (ndim,) = struct.unpack("<B", self._take(1))
+            dims = struct.unpack(f"<{ndim}Q", self._take(8 * ndim))
+            nbytes = (int(np.prod(dims, dtype=np.int64)) * dt.itemsize
+                      if ndim else dt.itemsize)
+            a = np.empty(dims, dtype=dt)
+            if nbytes:
+                self._readinto(memoryview(a).cast("B"))
+            arrays.append(a)
+        if self._pos:
+            # frame boundary: trim the consumed prefix so a long-lived
+            # pipelined connection can never grow the buffer unboundedly
+            # (pending next-frame bytes, if any, slide to the front)
+            del self._buf[:self._pos]
+            self._pos = 0
+        if not binary:
+            return kind, _restore(restricted_loads(skel_bytes), arrays), False
+        try:
+            payload = _decode_binary_skeleton(kind, skel_bytes, arrays)
+        except Exception as e:
+            # a garbled/truncated binary skeleton is corruption or desync:
+            # FrameError keeps it inside TRANSPORT_ERRORS so the connection
+            # is dropped and retry/reroute handle it like a garbled pickle
+            raise FrameError(
+                f"undecodable binary skeleton (kind {kind}): {e}") from e
+        return kind, payload, True
+
+    def recv_frame(self):
+        kind, payload, _binary = self.recv_frame_ex()
+        return kind, payload
 
 
 def recv_frame_ex(sock: socket.socket):
-    """``(kind, payload, was_binary)`` for one frame. Tensor planes land
-    in freshly allocated arrays via ``recv_into`` — straight from the
-    socket into the buffer the caller consumes, no further copy — for
-    BOTH skeleton encodings; only the skeleton decode differs (binary
-    layout vs pickle through the restricted unpickler). ``was_binary``
-    is the client demux's negotiation signal (the peer speaks binary)."""
-    head = _recv_exact(sock, _HDR.size)
-    magic, kind, skel_len, narr = _HDR.unpack(head)
-    if magic != MAGIC:
-        raise FrameError(f"bad frame magic {bytes(magic)!r}")
-    binary = bool(kind & WIRE_BINARY_FLAG)
-    kind &= ~WIRE_BINARY_FLAG
-    skel_bytes = _recv_exact(sock, skel_len)
-    arrays = []
-    for _ in range(narr):
-        (dt_len,) = struct.unpack("<B", _recv_exact(sock, 1))
-        try:
-            dt = np.dtype(bytes(_recv_exact(sock, dt_len)).decode())
-        except (TypeError, ValueError, UnicodeDecodeError) as e:
-            # a garbled plane header (desynced/corrupted stream) is a
-            # transport fault: FrameError keeps it inside
-            # TRANSPORT_ERRORS so retry/reroute/teardown handle it,
-            # instead of a bare TypeError escaping the retry machinery
-            raise FrameError(f"undecodable tensor plane header: {e}") from e
-        (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
-        dims = struct.unpack(f"<{ndim}Q", _recv_exact(sock, 8 * ndim))
-        nbytes = int(np.prod(dims, dtype=np.int64)) * dt.itemsize if ndim else dt.itemsize
-        a = np.empty(dims, dtype=dt)
-        if nbytes:
-            view = memoryview(a).cast("B")
-            got = 0
-            while got < nbytes:
-                r = sock.recv_into(view[got:], nbytes - got)
-                if r == 0:
-                    raise EOFError("connection closed mid-tensor")
-                got += r
-        arrays.append(a)
-    if not binary:
-        return kind, _restore(restricted_loads(skel_bytes), arrays), False
-    try:
-        # the memoryview passes through undecoded — the codec's reader
-        # slices it in place (only short string fields pay a bytes()
-        # copy), so a large inline labels block costs no skeleton memcpy
-        payload = _decode_binary_skeleton(kind, skel_bytes, arrays)
-    except Exception as e:
-        # a garbled/truncated binary skeleton is corruption or desync:
-        # FrameError keeps it inside TRANSPORT_ERRORS so the connection
-        # is dropped and retry/reroute handle it like a garbled pickle
-        raise FrameError(
-            f"undecodable binary skeleton (kind {kind}): {e}") from e
-    return kind, payload, True
+    """One-shot unbuffered read of a single frame (``bufsize=0``: never
+    over-reads past the frame, so it is safe on a socket whose later
+    bytes another reader owns). Connection-owning loops hold a
+    ``FrameReader`` instead — that is where the syscall win lives."""
+    return FrameReader(sock, bufsize=0).recv_frame_ex()
 
 
 def recv_frame(sock: socket.socket):
@@ -712,6 +786,11 @@ class Client:
         self._last_rx = time.monotonic()  # a fresh connection counts as live
         self._peer_tagged = None  # a restarted peer may speak another dialect
         self._peer_wire = False  # ... including a pickle-only one
+        # per-connection buffered reader for the SERIAL path (one call in
+        # flight: its response's header/skeleton/plane headers arrive in
+        # one recv). The demux reader owns the mux read side with its own
+        # FrameReader — this one is untouched in mux mode.
+        self._frame_reader = FrameReader(self.sock)
         if self._mux:
             self._reader = threading.Thread(
                 target=self._reader_loop, args=(self.sock, self._epoch),
@@ -728,8 +807,11 @@ class Client:
         in-flight call is the only one it can be answering). Any transport
         failure tears the connection down, failing every in-flight call."""
         try:
+            # one buffered reader per connection generation: pipelined
+            # responses queued behind each other decode out of one recv
+            reader = FrameReader(sock)
             while True:
-                kind, payload, was_binary = recv_frame_ex(sock)
+                kind, payload, was_binary = reader.recv_frame_ex()
                 base = _MUX_TO_BASE.get(kind)
                 tagged = base is not None
                 if tagged:
@@ -992,7 +1074,7 @@ class Client:
             t0 = time.perf_counter()
             try:
                 _send_parts(self.sock, parts)
-                kind, payload = recv_frame(self.sock)
+                kind, payload = self._frame_reader.recv_frame()
             except Exception:
                 # OSError/EOFError (socket timeouts, mid-frame stream ends)
                 # but also FrameError ("bad frame magic") and unpickling

@@ -86,12 +86,19 @@ class _ConnState:
     per-connection property that dies with the connection, exactly like
     the client-side half (rpc.Client._peer_wire)."""
 
-    __slots__ = ("addr", "wlock", "peer_wire")
+    __slots__ = ("addr", "wlock", "peer_wire", "reader")
 
-    def __init__(self, addr, wlock):
+    def __init__(self, addr, wlock, reader=None):
         self.addr = addr
         self.wlock = wlock
         self.peer_wire = False
+        # per-connection buffered frame reader (rpc.FrameReader): one
+        # recv typically covers header + skeleton + plane headers, and
+        # back-to-back pipelined CALL frames decode out of one recv.
+        # None for throwaway per-call states, which fall back to the
+        # unbuffered one-shot reader (over-reading there would DROP the
+        # buffered bytes when the state dies).
+        self.reader = reader
 
 
 class IndexServer:
@@ -367,6 +374,7 @@ class IndexServer:
         """The per-rank registration op: the client (or an operator)
         assigns this rank's replica group. Tagged into the scheduler's
         perf stats so per-replica admission numbers are attributable."""
+        # graftlint: atomic(shard_group): registration publish — one reference write; readers (digest answers, perf tags, fan-out planning) tolerate the pre-registration None or a one-sweep-stale group
         self.shard_group = None if group is None else int(group)
         if self.scheduler is not None:
             self.scheduler.tag["shard_group"] = self.shard_group
@@ -431,6 +439,7 @@ class IndexServer:
         if (self._antientropy is not None or self.discovery_path is None
                 or not self._antientropy_cfg.enabled):
             return
+        # graftlint: atomic(_antientropy): publish-once — assigned after the serving socket binds but before the accept loop admits any connection, so worker-pool readers only ever observe the final reference (stop() never nulls it)
         self._antientropy = antientropy.AntiEntropySweeper(
             self, self.discovery_path, self._antientropy_cfg)
         with self.indexes_lock:
@@ -671,6 +680,7 @@ class IndexServer:
         # named, tracked, and joined inside MetricsExporter.stop()
         if self._metrics is not None:
             self._metrics.stop()
+            # graftlint: atomic(_metrics): teardown null — outage-time stats calls snapshot the reference (get_perf_stats) by design, so they observe the listener or None, never a torn state
             self._metrics = None
         # stop the anti-entropy sweeper next: a sweep mid-heal would
         # race the shutdown saves for the engine locks, and its peer
@@ -716,6 +726,7 @@ class IndexServer:
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind(("", port))
         s.listen(16)
+        # graftlint: atomic(socket): bound once before either serving loop accepts; stop()'s null runs during teardown, where the loops already treat accept()/select() OSErrors as the exit signal
         self.socket = s
         self._start_metrics()
         return s
@@ -776,7 +787,8 @@ class IndexServer:
         # whichever thread completes the call (scheduler batcher via the
         # worker pool, or a worker running a direct op), so frame writes
         # must be serialized against each other and the sync path
-        state = _ConnState(addr, lockdep.lock("IndexServer.conn_wlock"))
+        state = _ConnState(addr, lockdep.lock("IndexServer.conn_wlock"),
+                           rpc.FrameReader(conn))
         try:
             while True:
                 self._one_call(conn, state=state)
@@ -801,7 +813,10 @@ class IndexServer:
             # per-call state keeps every dispatch path uniform — the mux
             # response writers dereference state unconditionally
             state = _ConnState(None, lockdep.lock("IndexServer.conn_wlock"))
-        kind, payload, was_binary = rpc.recv_frame_ex(conn)
+        if state.reader is not None:
+            kind, payload, was_binary = state.reader.recv_frame_ex()
+        else:
+            kind, payload, was_binary = rpc.recv_frame_ex(conn)
         wlock = state.wlock
         if kind == rpc.KIND_CLOSE:
             raise rpc.ClientExit("client closed")
@@ -1160,13 +1175,23 @@ class IndexServer:
                     sel.register(conn, selectors.EVENT_READ,
                                  data=_ConnState(
                                      addr,
-                                     lockdep.lock("IndexServer.conn_wlock")))
+                                     lockdep.lock("IndexServer.conn_wlock"),
+                                     rpc.FrameReader(conn)))
                 else:
                     conn = key.fileobj
                     addr = key.data.addr
                     try:
                         self._one_call(conn, eager_search=True,
                                        state=key.data)
+                        # the buffered reader may hold complete follower
+                        # frames (a pipelined burst landed in one recv):
+                        # serve them NOW — buffered bytes never make the
+                        # socket readable, so select() would stall them
+                        # until the peer's next send
+                        while (key.data.reader is not None
+                               and key.data.reader.pending):
+                            self._one_call(conn, eager_search=True,
+                                           state=key.data)
                     except (rpc.ClientExit, EOFError, OSError):
                         sel.unregister(conn)
                         conn.close()

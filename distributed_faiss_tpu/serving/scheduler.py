@@ -60,6 +60,7 @@ import numpy as np
 
 from distributed_faiss_tpu.observability import spans as obs_spans
 from distributed_faiss_tpu.utils import lockdep
+from distributed_faiss_tpu.utils.atomics import AtomicCounters
 from distributed_faiss_tpu.utils.config import SchedulerCfg
 from distributed_faiss_tpu.utils.tracing import LatencyStats
 
@@ -184,12 +185,11 @@ class SearchScheduler:
         self._queue: List[_Request] = []
         self._stopping = False
         self.stats = LatencyStats()
-        self._counters = {
-            "submitted": 0,
-            "batches": 0,
-            "shed_deadline": 0,
-            "rejected_busy": 0,
-        }
+        # admission/flush counters ride the shared atomic-counter helper
+        # (utils/atomics.py): the fast paths bump them without contending
+        # the flush condition, and stats readers get a torn-free snapshot
+        self._counters = AtomicCounters(
+            ("submitted", "batches", "shed_deadline", "rejected_busy"))
         self._thread = threading.Thread(
             target=self._batcher_loop, name=name, daemon=True)
         self._thread.start()
@@ -252,13 +252,13 @@ class SearchScheduler:
             if self._stopping:
                 raise SchedulerStopped("scheduler is stopped")
             if deadline is not None and time.monotonic() >= deadline:
-                self._counters["shed_deadline"] += 1
+                self._counters.inc("shed_deadline")
                 raise DeadlineExpired(
                     "deadline expired before the request was admitted")
             if len(self._queue) >= self.cfg.max_queue:
-                self._counters["rejected_busy"] += 1
+                self._counters.inc("rejected_busy")
                 raise SchedulerBusy(len(self._queue), self.cfg.max_queue)
-            self._counters["submitted"] += 1
+            self._counters.inc("submitted")
             self._queue.append(req)
             self._cond.notify_all()
         return req
@@ -362,8 +362,7 @@ class SearchScheduler:
             if r.deadline is not None and now >= r.deadline:
                 # shed without touching the device; the device batch only
                 # carries rows someone is still waiting for
-                with self._cond:
-                    self._counters["shed_deadline"] += 1
+                self._counters.inc("shed_deadline")
                 r.error = DeadlineExpired(
                     "deadline expired while queued "
                     f"(waited {now - r.enqueue_t:.3f}s)")
@@ -374,9 +373,7 @@ class SearchScheduler:
             live.append(r)
         if not live:
             return
-        with self._cond:
-            self._counters["batches"] += 1
-            window = self._counters["batches"]
+        window = self._counters.inc("batches")
         n_rows = sum(r.rows for r in live)
         self.stats.record("batch_requests", float(len(live)))
         self.stats.record("batch_rows", float(n_rows))
@@ -463,7 +460,11 @@ class SearchScheduler:
         ``raw`` adds the bucket histograms (the Prometheus exporter's
         view)."""
         with self._cond:
-            counters = dict(self._counters)
+            # torn-free counter snapshot taken beside the queue-length
+            # read (AtomicCounters._lock is a leaf: safe under _cond).
+            # Increments happen lock-free on the fast paths, so the two
+            # reads are adjacent, not a cross-field consistency guarantee.
+            counters = self._counters.snapshot()
             counters["queued"] = len(self._queue)
         out = {"counters": counters, "queues": self.stats.summary(raw=raw)}
         if self.tag:

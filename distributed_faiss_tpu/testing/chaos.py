@@ -146,6 +146,7 @@ class ChaosProxy:
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind(("127.0.0.1", self._listen_port))
         s.listen(16)
+        # graftlint: atomic(_listener, port): published in start() strictly before the accept thread exists — Thread.start() is the happens-before edge, and neither is ever rebound while the proxy lives
         self._listener = s
         self.port = s.getsockname()[1]
         self._accept_thread = threading.Thread(
@@ -292,7 +293,7 @@ class ChaosProxy:
     # dropping frames. The proxy reads LENGTH fields and the kind byte
     # only; payload bytes are forwarded (or dropped) opaque, never
     # unpickled. _read_exact stays local: the pump needs owned bytes
-    # (indexing, .decode()), not rpc._recv_exact's memoryview.
+    # (indexing, .decode()), not a view into rpc.FrameReader's buffer.
     _FRAME_HDR = rpc._HDR
     _FRAME_MAGIC = rpc.MAGIC
 

@@ -54,8 +54,14 @@ class LockOrderError(RuntimeError):
 
 def enabled() -> bool:
     """DFT_LOCKDEP master switch, read at lock-creation time (so tests
-    can flip it per-fixture and subprocess ranks inherit it)."""
-    return envutil.env_flag("DFT_LOCKDEP", False)
+    can flip it per-fixture and subprocess ranks inherit it).
+    DFT_RACECHECK=1 also turns the factories on: the shared-state race
+    witness (utils/racecheck.py) intersects CANDIDATE locksets against
+    ``held()``, which only tracks instrumented locks — an uninstrumented
+    lock under racecheck would read as 'no locks held' and false-flag
+    every guarded access."""
+    return (envutil.env_flag("DFT_LOCKDEP", False)
+            or envutil.env_flag("DFT_RACECHECK", False))
 
 
 # ---------------------------------------------------------------- graph state

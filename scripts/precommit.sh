@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Local fast-path for the checks CI runs on every push: the graftlint
-# lint (all 13 checkers; --changed keeps it to the files you touched so
+# lint (all 14 checkers; --changed keeps it to the files you touched so
 # the growing suite stays fast at commit time — CI lints the full tree)
 # plus the lint test tier (golden fixtures + CLI contract) and the
 # runtime-witness unit tests. Wire it up with:
@@ -8,7 +8,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "graftlint: linting changed files vs HEAD (all 13 checkers)"
+echo "graftlint: linting changed files vs HEAD (all 14 checkers)"
 python -m tools.graftlint --changed
 
 echo "graftlint: lint test tier"
@@ -22,5 +22,9 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_lockdep.py -q \
 echo "threadcheck: runtime thread-leak witness unit tests"
 JAX_PLATFORMS=cpu python -m pytest tests/test_threadcheck.py -q \
     -m "threadcheck and not slow" -p no:cacheprovider
+
+echo "racecheck: runtime shared-state race witness unit tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_racecheck.py -q \
+    -m "racecheck and not slow" -p no:cacheprovider
 
 echo "precommit: OK"

@@ -32,12 +32,18 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
-from distributed_faiss_tpu.utils import threadcheck
+from distributed_faiss_tpu.utils import racecheck, threadcheck
 
 # DFT_THREADCHECK=1: wrap Thread.start once, at collection time, so every
 # thread started anywhere in the suite carries creation provenance
 if threadcheck.enabled():
     threadcheck.install()
+
+# DFT_RACECHECK=1: instrument the lockdep-factory-locked classes once, at
+# collection time, so every instance the suite creates is witnessed from
+# birth (utils/racecheck.py; implies lockdep's held-lockset tracking)
+if racecheck.enabled():
+    racecheck.install()
 
 
 @pytest.fixture(autouse=True)
@@ -55,6 +61,23 @@ def _thread_leak_witness():
     before = threadcheck.snapshot()
     yield
     threadcheck.check(before)
+
+
+@pytest.fixture(autouse=True)
+def _shared_state_race_witness():
+    """DFT_RACECHECK=1 runtime witness (utils/racecheck.py): any
+    shared-state race recorded during this test fails it — including
+    races whose in-thread SharedStateRaceError a serving loop swallowed
+    (batcher/connection threads catch broadly by design, so the raise
+    alone cannot be the only failure path). Violations from earlier
+    tests are drained up front so blame lands on the test that provoked
+    the race. No-op when the knob is off."""
+    if not racecheck.enabled():
+        yield
+        return
+    racecheck.drain()
+    yield
+    racecheck.check()
 
 
 @pytest.fixture(scope="session")

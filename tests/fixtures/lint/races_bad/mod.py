@@ -1,0 +1,34 @@
+"""races_bad: shared state written on one thread root and touched on
+another with no common lock (shared-state-race golden fixture)."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.jobs = []
+        self.done = 0
+        self.flag = False
+        # graftlint: atomic(phantom): waives nothing -> in-class rot
+        self.t = threading.Thread(target=self._run, name="w", daemon=True)
+
+    def _run(self):
+        while True:
+            with self.lock:
+                self.jobs.pop()
+            if self.flag:
+                return
+            self.done += 1
+
+    def submit(self, job):
+        with self.lock:
+            self.jobs.append(job)
+
+    def stop(self):
+        self.flag = True
+        self.t.join(timeout=1.0)
+
+    def stats(self):
+        return self.done
+
+    # graftlint: atomic(ghost): no such attribute -> the marker is rot

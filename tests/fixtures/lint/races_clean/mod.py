@@ -1,0 +1,34 @@
+"""races_clean: every cross-root access shares a lock, is reviewed-
+benign (atomic marker), or carries a reasoned ok() waiver."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.jobs = []
+        # graftlint: atomic(ticks): benign monotonic heartbeat counter
+        self.ticks = 0
+        self.t = threading.Thread(target=self._run, name="w", daemon=True)
+
+    def _run(self):
+        while True:
+            with self.lock:
+                if not self.jobs:
+                    return
+                self.jobs.pop()
+            self.ticks += 1
+            # graftlint: ok(shared-state-race): reviewed - best-effort progress echo, staleness acceptable
+            self.echo = self.ticks
+
+    def submit(self, job):
+        with self.lock:
+            self.jobs.append(job)
+
+    def stop(self):
+        with self.lock:
+            self.jobs.append(None)
+        self.t.join(timeout=1.0)
+
+    def stats(self):
+        return (self.ticks, self.echo)

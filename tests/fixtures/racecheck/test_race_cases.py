@@ -1,0 +1,63 @@
+"""Doctored shared-state-race cases for the DFT_RACECHECK e2e tests.
+
+Driven by tests/test_racecheck.py in a subprocess with DFT_RACECHECK=1 +
+DFT_RACECHECK_E2E=1: the seeded-race case must FAIL under the conftest
+witness fixture even though the racing thread SWALLOWS its in-thread
+SharedStateRaceError (proving the real wiring — install at collection,
+drain/check around each test — catches swallowed raises), and the
+locked twin must pass. The env guard keeps every normal tier from
+running them: without the driver variables they skip.
+"""
+
+import os
+import threading
+
+import pytest
+
+from distributed_faiss_tpu.utils import lockdep, racecheck
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("DFT_RACECHECK_E2E") != "1",
+    reason="doctored case: driven by tests/test_racecheck.py subprocess")
+
+
+def _shared_class():
+    class Shared:
+        def __init__(self):
+            self.lock = lockdep.lock("Shared.lock")
+            self.value = 0
+
+    return racecheck.instrument(Shared)
+
+
+def test_seeded_race_fails_via_the_fixture():
+    """The racing write happens on a worker thread that swallows the
+    raise (serving loops catch broadly by design) — only the conftest
+    fixture's post-test check can fail this test."""
+    obj = _shared_class()()
+
+    def doctored_racy_write():
+        try:
+            obj.value = 1  # lock-free write from a second thread
+        except racecheck.SharedStateRaceError:
+            pass  # swallowed on purpose: the fixture must still fail us
+
+    t = threading.Thread(target=doctored_racy_write,
+                         name="doctored-racer", daemon=True)
+    t.start()
+    t.join(5.0)
+
+
+def test_locked_twin_is_clean():
+    obj = _shared_class()()
+
+    def locked_write():
+        with obj.lock:
+            obj.value = 1
+
+    t = threading.Thread(target=locked_write, name="doctored-locked",
+                         daemon=True)
+    t.start()
+    t.join(5.0)
+    with obj.lock:
+        obj.value = 2

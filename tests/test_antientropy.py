@@ -43,6 +43,8 @@ from distributed_faiss_tpu.utils.config import (
     ReplicationCfg,
 )
 from distributed_faiss_tpu.utils.state import IndexState
+from distributed_faiss_tpu.utils import lockdep, racecheck
+from distributed_faiss_tpu.utils.atomics import AtomicCounters
 
 pytestmark = pytest.mark.antientropy
 
@@ -168,14 +170,18 @@ def test_ledger_survives_compaction_and_readds_unledger(tmp_path):
     eng.add_batch(x, [(i,) for i in range(20)], train_async_if_triggered=False)
     wait_for(lambda: drained(eng))
     eng.remove_ids([2, 3])
-    assert eng.tombstones.ledger() == {2, 3}
+    with racecheck.peeking():  # white-box peek, reviewed
+        assert eng.tombstones.ledger() == {2, 3}
     assert eng.compact()
     # rows reclaimed, ledger intact
-    assert len(eng.tombstones) == 0
-    assert eng.tombstones.ledger() == {2, 3}
+    with racecheck.peeking():  # white-box peek, reviewed
+        assert len(eng.tombstones) == 0
+    with racecheck.peeking():  # white-box peek, reviewed
+        assert eng.tombstones.ledger() == {2, 3}
     # a legal re-add (upsert) removes its ledger entry
     eng.add_batch(x[2:3], [(2,)], train_async_if_triggered=False)
-    assert eng.tombstones.ledger() == {3}
+    with racecheck.peeking():  # white-box peek, reviewed
+        assert eng.tombstones.ledger() == {3}
 
 
 def test_tombstone_payload_roundtrips_ledger():
@@ -363,10 +369,10 @@ def make_client(stubs, rcfg=None, groups=None):
     c.cur_server_ids = {}
     c._rng = random.Random(0)
     c.retry = rpc.RetryPolicy(max_attempts=2, base_delay=0.001, jitter=0.0)
-    c._stats_lock = threading.Lock()
+    c._stats_lock = lockdep.lock("IndexClient._stats_lock")
     c.reroutes = deque(maxlen=REROUTE_LOG_LEN)
-    c.counters = {"reroutes": 0, "failovers": 0,
-                  "under_replicated": 0, "quorum_failures": 0}
+    c.counters = AtomicCounters(
+                  ("reroutes", "failovers", "under_replicated", "quorum_failures"))
     c.rcfg = rcfg or ReplicationCfg()
     eff = min(c.rcfg.replication, max(len(stubs), 1))
     c.quorum = replication.quorum_size(eff, min(c.rcfg.write_quorum, eff))
@@ -1002,10 +1008,12 @@ def test_ledger_prunes_after_cluster_watermark_never_while_suspect(tmp_path):
         for srv in (a, b):
             srv.remove_ids("t", [0, 1, 2], version=vdel)
         for eng in (a._get_index("t"), b._get_index("t")):
-            assert eng.tombstones.ledger_size() == 3
+            with racecheck.peeking():  # white-box peek, reviewed
+                assert eng.tombstones.ledger_size() == 3
         # the delete IS the watermark: nothing is strictly below it yet
         a._antientropy.sweep_once()
-        assert a._get_index("t").tombstones.ledger_size() == 3
+        with racecheck.peeking():  # white-box peek, reviewed
+            assert a._get_index("t").tombstones.ledger_size() == 3
 
         # a newer write on both replicas moves every watermark past vdel
         vnew = clock.tick()
@@ -1020,9 +1028,11 @@ def test_ledger_prunes_after_cluster_watermark_never_while_suspect(tmp_path):
             f.write(f"3\nlocalhost,{pa}\nlocalhost,{pb}\n"
                     f"localhost,{pdead}\n")
         a._antientropy.sweep_once()
-        assert a._get_index("t").tombstones.ledger_size() == 3
+        with racecheck.peeking():  # white-box peek, reviewed
+            assert a._get_index("t").tombstones.ledger_size() == 3
         a._antientropy.sweep_once()  # now suspect-marked: still no prune
-        assert a._get_index("t").tombstones.ledger_size() == 3
+        with racecheck.peeking():  # white-box peek, reviewed
+            assert a._get_index("t").tombstones.ledger_size() == 3
         # the dead address is decommissioned (removed from discovery) but
         # a LIVE unregistered peer (no shard_group yet — a fresh restart
         # no client has dialed) joins: it might be a member of OUR
@@ -1037,7 +1047,8 @@ def test_ledger_prunes_after_cluster_watermark_never_while_suspect(tmp_path):
             f.write(f"3\nlocalhost,{pa}\nlocalhost,{pb}\nlocalhost,{pc}\n")
         try:
             a._antientropy.sweep_once()
-            assert a._get_index("t").tombstones.ledger_size() == 3
+            with racecheck.peeking():  # white-box peek, reviewed
+                assert a._get_index("t").tombstones.ledger_size() == 3
             # ... until it registers into a DIFFERENT group: another
             # group's replica never blocks ours
             c.set_shard_group(1)
@@ -1049,12 +1060,14 @@ def test_ledger_prunes_after_cluster_watermark_never_while_suspect(tmp_path):
             with open(disc, "w") as f:
                 f.write(f"2\nlocalhost,{pa}\nlocalhost,{pb}\n")
         eng_a = a._get_index("t")
-        assert eng_a.tombstones.ledger_size() == 0
+        with racecheck.peeking():  # white-box peek, reviewed
+            assert eng_a.tombstones.ledger_size() == 0
         assert eng_a.mutation_stats()["ledger_pruned"] == 3
         assert a._antientropy.stats()["ledger_pruned"] == 3
         # B prunes from its own sweep
         b._antientropy.sweep_once()
-        assert b._get_index("t").tombstones.ledger_size() == 0
+        with racecheck.peeking():  # white-box peek, reviewed
+            assert b._get_index("t").tombstones.ledger_size() == 0
         # pruning persisted: the reloaded sidecar stays pruned
         sets = eng_a.id_sets()
         assert sets["dead"] == []
@@ -1107,7 +1120,8 @@ def test_delete_churn_ledger_stays_bounded(tmp_path):
             b._antientropy.sweep_once()
         total_deleted = batch * rounds
         for srv in (a, b):
-            size = srv._get_index("t").tombstones.ledger_size()
+            with racecheck.peeking():  # white-box peek, reviewed
+                size = srv._get_index("t").tombstones.ledger_size()
             # without pruning this is total_deleted (40); with it, only
             # the final round's pairs (nothing newer outranks them yet)
             # survive

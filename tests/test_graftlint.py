@@ -310,6 +310,40 @@ def test_exceptions_clean_fixture():
     assert _lint(f"{FIX}/exceptions_clean") == []
 
 
+def test_races_bad_fixture():
+    assert _locs(_lint(f"{FIX}/races_bad")) == [
+        ("shared-state-race", 12),  # in-class stale atomic() marker
+        ("shared-state-race", 21),  # thread-root write vs lock-free api read
+        ("shared-state-race", 28),  # api write vs lock-free thread-root read
+        ("shared-state-race", 34),  # outside-class stale atomic() marker
+    ]
+
+
+def test_races_finding_carries_per_root_provenance():
+    msgs = {f.line: f.message for f in _lint(f"{FIX}/races_bad")}
+    # both sides named with root + file:line, and the lock-guarded attr
+    # (jobs, under self.lock on every root) is NOT among the findings
+    assert "thread:Worker._run" in msgs[21] and "`api`" in msgs[21]
+    assert "races_bad/mod.py:32" in msgs[21]  # the read side's provenance
+    assert "races_bad/mod.py:19" in msgs[28]  # the read side's provenance
+    assert "stale atomic(phantom)" in msgs[12]
+    assert "stale atomic(ghost)" in msgs[34]
+    assert not any("jobs" in m for m in msgs.values())
+
+
+def test_races_clean_fixture():
+    # locked on every root / atomic()-waived counter / reasoned ok():
+    # all three escape hatches, zero findings, zero rot
+    assert _lint(f"{FIX}/races_clean") == []
+
+
+def test_races_rule_gates_off_on_subset_lints():
+    """--changed subsets cannot see the thread roots in OTHER modules, so
+    the whole rule (findings AND the atomic-rot audit) gates off."""
+    assert lint_paths(
+        [os.path.join(FIX, "races_bad", "mod.py")], subset=True) == []
+
+
 # ------------------------------------------------------- suppression audit
 
 def test_stale_suppression_is_flagged(tmp_path):
@@ -428,15 +462,15 @@ def test_cli_list_rules():
                  "lock-discipline", "lock-order", "blocking-under-lock",
                  "frame-protocol", "pallas-guard", "pickle-safety",
                  "thread-lifecycle", "generation-commit", "env-knob-drift",
-                 "exception-classification"):
+                 "exception-classification", "shared-state-race"):
         assert rule in proc.stdout
 
 
-def test_all_thirteen_checkers_registered():
+def test_all_fourteen_checkers_registered():
     from tools.graftlint import checks
 
-    assert len(checks.ALL) == 13
-    assert len(checks.RULES) == 13
+    assert len(checks.ALL) == 14
+    assert len(checks.RULES) == 14
 
 
 def test_cli_changed_mode(tmp_path):

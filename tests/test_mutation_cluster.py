@@ -23,6 +23,8 @@ from distributed_faiss_tpu.parallel.replication import (
     assign_groups,
 )
 from distributed_faiss_tpu.utils.config import IndexCfg, ReplicationCfg
+from distributed_faiss_tpu.utils import lockdep
+from distributed_faiss_tpu.utils.atomics import AtomicCounters
 
 pytestmark = [pytest.mark.mutation, pytest.mark.replication]
 
@@ -74,10 +76,10 @@ def make_client(stubs, rcfg=None, groups=None):
     c.cur_server_ids = {}
     c._rng = random.Random(0)
     c.retry = rpc.RetryPolicy(max_attempts=2, base_delay=0.001, jitter=0.0)
-    c._stats_lock = threading.Lock()
+    c._stats_lock = lockdep.lock("IndexClient._stats_lock")
     c.reroutes = deque(maxlen=REROUTE_LOG_LEN)
-    c.counters = {"reroutes": 0, "failovers": 0,
-                  "under_replicated": 0, "quorum_failures": 0}
+    c.counters = AtomicCounters(
+                  ("reroutes", "failovers", "under_replicated", "quorum_failures"))
     c.rcfg = rcfg or ReplicationCfg()
     eff = min(c.rcfg.replication, max(len(stubs), 1))
     c.quorum = replication.quorum_size(eff, min(c.rcfg.write_quorum, eff))

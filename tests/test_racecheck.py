@@ -1,0 +1,351 @@
+"""Runtime shared-state race witness tests (marker ``racecheck``; the
+subprocess tier re-run is additionally ``slow``).
+
+Unit layer: the DFT_RACECHECK=1 witness (utils/racecheck.py) runs the
+Eraser state machine per (instance, attribute) — a cross-thread write
+with an empty candidate lockset raises SharedStateRaceError with thread
++ file:line provenance for both sides; lockset refinement keeps a
+consistently-held lock from false-flagging; RLock reentry and
+Condition.wait's release/re-acquire are handled; read-only sharing and
+construction-time publishes never report; EXEMPT pairs, the peeking()
+suspension, and the DFT_RACECHECK_SAMPLE read-sampling knob are honored.
+
+E2e layer: a subprocess pytest run over the doctored cases in
+tests/fixtures/racecheck/ proves the REAL wiring — conftest instruments
+at collection, the autouse fixture drains/checks around each test —
+fails a seeded race whose in-thread raise was SWALLOWED, and passes the
+locked twin.
+
+Tier layer (``pytest -m racecheck``, mirrored by the ci.yml
+``racecheck`` job): re-run the scheduler, rpc-mux, replication,
+anti-entropy, mutation, and versions suites with the witness on — the
+dynamic complement of graftlint's static shared-state-race checker,
+exactly as lockdep is to lock-order and threadcheck to thread-lifecycle.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from distributed_faiss_tpu.utils import lockdep, racecheck
+
+pytestmark = pytest.mark.racecheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def witness(monkeypatch):
+    """DFT_RACECHECK=1 (which also flips the lockdep factories on, so
+    ``held()`` tracks the locks the candidate sets intersect). Classes
+    are instrumented per test via racecheck.instrument; recorded
+    violations are drained on the way out so a deliberate race here can
+    never leak into another test's check."""
+    monkeypatch.setenv("DFT_RACECHECK", "1")
+    monkeypatch.delenv("DFT_RACECHECK_SAMPLE", raising=False)
+    yield
+    racecheck.reset()
+
+
+def _fresh(name="Shared", lock_factory=lockdep.lock, lock_name=None):
+    """A new instrumented class owning one lockdep-factory lock."""
+
+    class Shared:
+        def __init__(self):
+            self.lock = lock_factory(lock_name or f"{name}.lock")
+            self.value = 0
+
+    Shared.__name__ = name
+    return racecheck.instrument(Shared)
+
+
+def _run_in_thread(fn, name="racer"):
+    box = {}
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:  # captured for assertions
+            box["exc"] = e
+
+    t = threading.Thread(target=run, name=name, daemon=True)
+    t.start()
+    t.join(10.0)
+    assert not t.is_alive()
+    return box.get("exc")
+
+
+# ------------------------------------------------------------------ switch
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("DFT_RACECHECK", raising=False)
+    assert not racecheck.enabled()
+
+
+def test_enabled_implies_lockdep(witness, monkeypatch):
+    monkeypatch.delenv("DFT_LOCKDEP", raising=False)
+    assert racecheck.enabled()
+    assert lockdep.enabled()  # held-lockset tracking is the witness's input
+
+
+# ------------------------------------------------------------- the machine
+
+def test_two_thread_empty_lockset_raises_with_provenance(witness):
+    obj = _fresh("ProvCls")()
+    obj.value = 2  # creator-thread write: exclusive, constrains nothing
+
+    def locked_write():
+        with obj.lock:
+            obj.value = 3  # transition: candidate = {ProvCls.lock}
+
+    assert _run_in_thread(locked_write) is None
+    with pytest.raises(racecheck.SharedStateRaceError) as exc:
+        obj.value = 4  # lock-free: candidate empties with a write -> raise
+    msg = str(exc.value)
+    assert "ProvCls.value" in msg
+    assert "test_racecheck.py:" in msg          # this access's site
+    assert "last write by" in msg               # the other side's site
+    assert "MainThread" in msg
+    racecheck.reset()
+
+
+def test_lockset_refinement_keeps_common_lock_quiet(witness):
+    cls = _fresh("Refined")
+    obj = cls()
+    extra = lockdep.lock("Refined.extra")
+
+    def writer():
+        for _ in range(50):
+            with obj.lock:
+                obj.value += 1
+        with extra:
+            with obj.lock:  # extra locks refine but never empty the set
+                obj.value += 1
+
+    assert _run_in_thread(writer, "w1") is None
+    assert _run_in_thread(writer, "w2") is None
+    with obj.lock:
+        obj.value += 1
+    assert racecheck.drain() == []
+
+
+def test_rlock_reentry_is_not_a_violation(witness):
+    cls = _fresh("Reent", lock_factory=lockdep.rlock)
+    obj = cls()
+
+    def writer():
+        with obj.lock:
+            with obj.lock:  # legal RLock reentry: still one held key
+                obj.value += 1
+
+    assert _run_in_thread(writer) is None
+    with obj.lock:
+        obj.value += 1
+    assert racecheck.drain() == []
+
+
+def test_condition_wait_release_is_handled(witness):
+    class Queue:
+        def __init__(self):
+            self.cond = lockdep.condition("Queue.cond")
+            self.items = 0
+
+    racecheck.instrument(Queue)
+    q = Queue()
+    started = threading.Event()
+
+    def consumer():
+        with q.cond:
+            started.set()
+            while q.items == 0:
+                q.cond.wait(timeout=5.0)  # drops the key for the wait
+            q.items -= 1
+
+    t = threading.Thread(target=consumer, name="consumer", daemon=True)
+    t.start()
+    assert started.wait(5.0)
+    with q.cond:
+        q.items += 1  # under the condition: candidate stays {Queue.cond}
+        q.cond.notify_all()
+    t.join(10.0)
+    assert not t.is_alive()
+    assert racecheck.drain() == []
+
+
+def test_read_only_sharing_never_reports(witness):
+    obj = _fresh("ReadOnly")()
+    obj.value = 7  # construction-time publish by the creator
+
+    def reader():
+        for _ in range(20):
+            assert obj.value == 7  # lock-free reads: Shared, not Modified
+
+    assert _run_in_thread(reader, "r1") is None
+    assert _run_in_thread(reader, "r2") is None
+    assert racecheck.drain() == []
+
+
+def test_exempt_pairs_are_never_tracked(witness):
+    class Index:  # matches the EXEMPT ("Index", "cfg") pair by name
+        def __init__(self):
+            self.lock = lockdep.lock("ExemptIndex.lock")
+            self.cfg = None
+
+    racecheck.instrument(Index)
+    obj = Index()
+
+    def racy_cfg_write():
+        obj.cfg = object()  # would violate, but the pair is exempt
+
+    assert ("Index", "cfg") in racecheck.EXEMPT
+    assert _run_in_thread(racy_cfg_write) is None
+    obj.cfg = object()
+    assert racecheck.drain() == []
+
+
+def test_swallowed_raise_is_still_recorded_for_check(witness):
+    obj = _fresh("Swallowed")()
+    obj.value = 1
+
+    def racy():
+        try:
+            obj.value = 2  # second-thread lock-free write -> raises
+        except racecheck.SharedStateRaceError:
+            pass  # a serving loop would swallow it exactly like this
+
+    assert _run_in_thread(racy) is None
+    with pytest.raises(racecheck.SharedStateRaceError, match="Swallowed"):
+        racecheck.check()
+    assert racecheck.drain() == []  # check() drained
+
+
+def test_peeking_suspends_the_witness_on_this_thread(witness):
+    obj = _fresh("Peeked")()
+    obj.value = 1
+
+    def racy():
+        with racecheck.peeking():
+            obj.value = 2  # a reviewed white-box poke: not witnessed
+
+    assert _run_in_thread(racy) is None
+    assert racecheck.drain() == []
+
+
+def test_sample_knob_gates_reads_but_never_writes(witness, monkeypatch):
+    monkeypatch.setenv("DFT_RACECHECK_SAMPLE", "0")
+    calls = []
+    real = racecheck._witness
+
+    def counting(obj, cls_name, attr, is_write, depth=3):
+        calls.append((attr, is_write))
+        return real(obj, cls_name, attr, is_write, depth + 1)
+
+    monkeypatch.setattr(racecheck, "_witness", counting)
+    obj = _fresh("Sampled")()
+    obj.value = 1          # write: always witnessed
+    _ = obj.value          # read: sampled out at rate 0
+    assert ("value", True) in calls
+    assert ("value", False) not in calls
+    racecheck.reset()
+
+
+def test_instrument_is_idempotent_and_deinstrument_restores(witness):
+    class C:
+        def __init__(self):
+            self.lock = lockdep.lock("C.lock")
+
+    orig_set = C.__setattr__
+    racecheck.instrument(C)
+    wrapped = C.__setattr__
+    assert wrapped is not orig_set
+    racecheck.instrument(C)  # second instrument must not double-wrap
+    assert C.__setattr__ is wrapped
+    racecheck.deinstrument(C)
+    assert C.__setattr__ is orig_set
+    racecheck.deinstrument(C)  # idempotent too
+    assert C.__setattr__ is orig_set
+
+
+def test_registry_resolves_and_uninstall_restores():
+    """Every INSTRUMENTED (module, class) entry must import and resolve —
+    the registry is a hand-maintained mirror of the lockdep-factory
+    classes, and a renamed class must fail HERE, not silently evaporate
+    the witness's coverage."""
+    import importlib
+
+    for mod_name, cls_name in racecheck.INSTRUMENTED:
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        assert isinstance(cls, type), (mod_name, cls_name)
+    was_empty = not racecheck._installed
+    racecheck.install()
+    try:
+        for cls in racecheck._installed:
+            assert cls.__dict__.get("__racecheck_orig__")
+    finally:
+        if was_empty:
+            racecheck.uninstall()
+
+
+# ----------------------------------------------------------------------- e2e
+
+def _run_doctored(case: str):
+    env = dict(os.environ, DFT_RACECHECK="1", DFT_RACECHECK_E2E="1",
+               JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "pytest",
+         f"tests/fixtures/racecheck/test_race_cases.py::{case}",
+         "-q", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+
+
+def test_e2e_conftest_fixture_fails_seeded_race():
+    proc = _run_doctored("test_seeded_race_fails_via_the_fixture")
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "SharedStateRaceError" in proc.stdout
+    assert "Shared.value" in proc.stdout
+    assert "test_race_cases.py:" in proc.stdout  # access provenance
+
+
+def test_e2e_locked_twin_passes():
+    proc = _run_doctored("test_locked_twin_is_clean")
+    assert proc.returncode == 0, (
+        f"locked twin failed under the witness:\n"
+        f"{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}")
+
+
+def test_e2e_cases_skip_without_driver_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DFT_RACECHECK_E2E", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/fixtures/racecheck/test_race_cases.py",
+         "-q", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 skipped" in proc.stdout
+
+
+# ------------------------------------------------------------------ the tier
+
+@pytest.mark.slow
+def test_concurrent_suites_under_witness():
+    """The racecheck-tier satellite (mirrors the lockdep/threadcheck
+    tiers): re-run the scheduler, rpc-mux, replication, anti-entropy,
+    mutation, and versions fast suites with DFT_RACECHECK=1 — every
+    cross-thread empty-lockset access fails its test with provenance."""
+    env = dict(os.environ, DFT_RACECHECK="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_scheduler.py", "tests/test_scheduler_identity.py",
+         "tests/test_rpc.py", "tests/test_rpc_mux.py",
+         "tests/test_replication.py", "tests/test_antientropy.py",
+         "tests/test_mutation.py", "tests/test_mutation_cluster.py",
+         "tests/test_versions.py",
+         "-q", "-m", "not slow", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=2400)
+    assert proc.returncode == 0, (
+        f"racecheck tier failed:\n{proc.stdout[-6000:]}\n"
+        f"{proc.stderr[-2000:]}")

@@ -26,6 +26,8 @@ from distributed_faiss_tpu.parallel.replication import (
     plan_read_fanout,
     quorum_size,
 )
+from distributed_faiss_tpu.utils import lockdep
+from distributed_faiss_tpu.utils.atomics import AtomicCounters
 from distributed_faiss_tpu.utils.config import IndexCfg, ReplicationCfg
 
 pytestmark = pytest.mark.replication
@@ -176,11 +178,11 @@ def make_client(stubs, rcfg=None, groups=None):
     c.cur_server_ids = {}
     c._rng = random.Random(0)
     c.retry = rpc.RetryPolicy(max_attempts=2, base_delay=0.001, jitter=0.0)
-    c._stats_lock = threading.Lock()
+    c._stats_lock = lockdep.lock("IndexClient._stats_lock")
     from collections import deque
     c.reroutes = deque(maxlen=REROUTE_LOG_LEN)
-    c.counters = {"reroutes": 0, "failovers": 0,
-                  "under_replicated": 0, "quorum_failures": 0}
+    c.counters = AtomicCounters(
+        ("reroutes", "failovers", "under_replicated", "quorum_failures"))
     c.rcfg = rcfg or ReplicationCfg()
     eff = min(c.rcfg.replication, max(len(stubs), 1))
     c.quorum = replication.quorum_size(eff, min(c.rcfg.write_quorum, eff))
@@ -209,8 +211,9 @@ def test_write_fans_out_to_every_replica_and_acks_on_full_quorum():
     assert [f for f, _ in a.acked] == ["add_index_data"]
     assert [f for f, _ in b.acked] == ["add_index_data"]
     assert len(client.repair_queue) == 0
-    assert client.counters == {"reroutes": 0, "failovers": 0,
-                               "under_replicated": 0, "quorum_failures": 0}
+    assert client.counters.snapshot() == {
+        "reroutes": 0, "failovers": 0,
+        "under_replicated": 0, "quorum_failures": 0}
 
 
 def test_write_quorum_reached_records_missed_replica_for_repair():

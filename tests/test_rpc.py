@@ -427,6 +427,9 @@ def test_wire_frames_decode_through_restricted_unpickler():
             view[: len(chunk)] = chunk
             return len(chunk)
 
+        def recv(self, n):
+            return self.buf.read(n)
+
     kind, payload = rpc.recv_frame(FakeSock(b"".join(bytes(p) for p in parts)))
     assert kind == rpc.KIND_CALL
     fname, args, kwargs = payload

@@ -19,6 +19,8 @@ import time
 import numpy as np
 import pytest
 
+from distributed_faiss_tpu.utils import racecheck
+
 from distributed_faiss_tpu import (
     Index,
     IndexCfg,
@@ -457,7 +459,8 @@ def test_percall_timeout_on_tagged_peer_abandons_only_that_call():
     assert isinstance(outcomes["doomed"], OSError)
     assert outcomes["companion"] == "companion-ok"  # NOT collaterally failed
     # same connection, no redial: the window survived the timeout
-    assert not c._closed
+    with racecheck.peeking():  # white-box peek, reviewed
+        assert not c._closed
     assert c.generic_fun("after", ()) == "after-ok"
     c.close()
     lsock.close()

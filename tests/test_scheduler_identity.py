@@ -17,6 +17,8 @@ import time
 import numpy as np
 import pytest
 
+from distributed_faiss_tpu.utils import racecheck
+
 from distributed_faiss_tpu import (
     IndexCfg,
     IndexClient,
@@ -335,8 +337,9 @@ def test_mesh_backed_clients_identical_and_one_launch_per_window(tmp_path):
     from distributed_faiss_tpu.parallel.mesh import ShardedFlatIndex
 
     for arm in setups:
-        assert isinstance(setups[arm][0].indexes[index_id].tpu_index,
-                          ShardedFlatIndex)
+        with racecheck.peeking():  # white-box peek, reviewed
+            assert isinstance(setups[arm][0].indexes[index_id].tpu_index,
+                              ShardedFlatIndex)
 
     results = {"on": {}, "off": {}}
     errors = []

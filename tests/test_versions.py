@@ -31,6 +31,8 @@ from distributed_faiss_tpu.utils.state import (
     STALE_READ_REJECTION_PREFIX,
     IndexState,
 )
+from distributed_faiss_tpu.utils import lockdep, racecheck
+from distributed_faiss_tpu.utils.atomics import AtomicCounters
 
 pytestmark = pytest.mark.versions
 
@@ -300,7 +302,8 @@ def test_plain_versioned_ingest_never_replaces_shared_ids(tmp_path, rng):
                           train_async_if_triggered=False,
                           version=clock.tick())
         wait_drained(idx, 40)
-        assert len(idx.tombstones) == 0
+        with racecheck.peeking():  # white-box peek, reviewed
+            assert len(idx.tombstones) == 0
         assert idx.mutation_stats()["version_replaced"] == 0
         sets = idx.id_sets()
         assert sets["live"].count("doc") == 40
@@ -353,7 +356,8 @@ def test_mixed_version_reconcile_records_per_key_versions(tmp_path, rng):
             [7, 8], [[7, list(vd_new)], [8, list(vd_old)]])
         assert removed == 1              # 7 deleted; 8's upsert survives
         assert 8 in idx.get_ids() and 7 not in idx.get_ids()
-        assert idx.tombstones.ledger_version(7) == vd_new
+        with racecheck.peeking():  # white-box peek, reviewed
+            assert idx.tombstones.ledger_version(7) == vd_new
     finally:
         idx.retire()
 
@@ -399,7 +403,8 @@ def test_reconcile_deletes_versioned_gates(tmp_path, rng):
         newer = clock.tick()
         assert idx.reconcile_deletes([4], [[4, list(newer)]]) == 1
         assert 4 not in idx.get_ids()
-        assert idx.tombstones.ledger_version(4) == newer
+        with racecheck.peeking():  # white-box peek, reviewed
+            assert idx.tombstones.ledger_version(4) == newer
         # unversioned peer delete vs a versioned live row: the versioned
         # write outranks the minimal legacy delete
         assert idx.reconcile_deletes([5]) == 0
@@ -607,12 +612,12 @@ def make_client(stubs, rcfg=None, vcfg=None):
     c.cur_server_ids = {}
     c._rng = random.Random(0)
     c.retry = rpc.RetryPolicy(max_attempts=2, base_delay=0.001, jitter=0.0)
-    c._stats_lock = threading.Lock()
+    c._stats_lock = lockdep.lock("IndexClient._stats_lock")
     from collections import deque
 
     c.reroutes = deque(maxlen=8)
-    c.counters = {"reroutes": 0, "failovers": 0,
-                  "under_replicated": 0, "quorum_failures": 0}
+    c.counters = AtomicCounters(
+                  ("reroutes", "failovers", "under_replicated", "quorum_failures"))
     c.rcfg = rcfg or ReplicationCfg()
     eff = min(c.rcfg.replication, max(len(stubs), 1))
     c.quorum = replication.quorum_size(eff, min(c.rcfg.write_quorum, eff))
@@ -837,7 +842,8 @@ def test_full_sync_vetoed_by_gated_peer_delete(tmp_path):
         healed = [h for h in out["healed"] if h["index_id"] == "t"]
         assert healed and healed[0]["full_sync"] is False, healed
         assert 5 in a._get_index("t").get_ids(), "full sync ate the upsert"
-        assert a._get_index("t").tombstones.live_version(5) == v3
+        with racecheck.peeking():  # white-box peek, reviewed
+            assert a._get_index("t").tombstones.live_version(5) == v3
         assert {100 + i for i in range(8)} <= a._get_index("t").get_ids()
     finally:
         for srv in servers:

@@ -517,3 +517,144 @@ def test_garble_on_negotiated_connection_retries_unchanged(tmp_path):
         srv._stopping.set()
         srv.socket.close()
         srv.scheduler.stop()
+
+
+# ------------------------------------------------- buffered frame reader
+
+
+class _ScriptedSock:
+    """A fake socket over a fixed byte script, counting syscalls. ``recv``
+    returns up to ``n`` bytes (everything queued is 'available', like a
+    kernel buffer after a pipelined burst); ``recv_into`` fills the view
+    from the same stream."""
+
+    def __init__(self, data, chunk=None):
+        self._data = memoryview(bytes(data))
+        self._ofs = 0
+        self._chunk = chunk  # cap per-recv bytes (exercises short reads)
+        self.recv_calls = 0
+
+    def _grab(self, n):
+        self.recv_calls += 1
+        if self._chunk is not None:
+            n = min(n, self._chunk)
+        take = self._data[self._ofs:self._ofs + n]
+        self._ofs += len(take)
+        return take
+
+    def recv(self, n):
+        return bytes(self._grab(n))
+
+    def recv_into(self, view, n):
+        take = self._grab(min(n, len(view)))
+        view[:len(take)] = take
+        return len(take)
+
+
+def _sample_frames():
+    """A mixed pipelined burst: pickle CALL with tensor planes, binary
+    CALL, tagged binary RESULT with planes, pickle BUSY."""
+    q = np.arange(24, dtype=np.float32).reshape(3, 8)
+    frames = []
+    frames.append(rpc.pack_frame(
+        rpc.KIND_CALL, ("add_index_data", ("idx", q, [("m", i) for i in
+                                                      range(3)]), {})))
+    frames.append(rpc.pack_binary_call(
+        "search", ("idx", q, 5, False), {}, {"req_id": 7, "wire": 1}))
+    scores = np.linspace(0.0, 1.0, 15, dtype=np.float32).reshape(3, 5)
+    labels = [[(i, j) for j in range(5)] for i in range(3)]
+    frames.append(rpc.pack_binary_response(
+        rpc.KIND_RESULT, (scores, labels, None), req_id=7))
+    frames.append(rpc.pack_frame(rpc.KIND_BUSY, {"reason": "queue_full",
+                                                 "queue_depth": 9,
+                                                 "max_queue": 9}))
+    return frames
+
+
+def _deep_equal(a, b):
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return (isinstance(a, np.ndarray) and isinstance(b, np.ndarray)
+                and a.dtype == b.dtype and a.shape == b.shape
+                and bool((a == b).all()))
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return (type(a) is type(b) and len(a) == len(b)
+                and all(_deep_equal(x, y) for x, y in zip(a, b)))
+    if isinstance(a, dict) and isinstance(b, dict):
+        return (set(a) == set(b)
+                and all(_deep_equal(a[k], b[k]) for k in a))
+    return a == b
+
+
+def test_buffered_reader_byte_identity_vs_unbuffered():
+    """The buffered FrameReader decodes a pipelined burst to EXACTLY what
+    the unbuffered one-shot reader produces frame by frame — kinds,
+    binary flags, payload structure, and tensor bytes."""
+    frames = _sample_frames()
+    blob = b"".join(bytes(p) for f in frames for p in f)
+
+    buffered = rpc.FrameReader(_ScriptedSock(blob))
+    got_buf = [buffered.recv_frame_ex() for _ in frames]
+
+    unbuffered_sock = _ScriptedSock(blob)
+    got_unbuf = [rpc.recv_frame_ex(unbuffered_sock) for _ in frames]
+
+    assert len(got_buf) == len(got_unbuf) == len(frames)
+    for (k1, p1, b1), (k2, p2, b2) in zip(got_buf, got_unbuf):
+        assert k1 == k2 and b1 == b2
+        assert _deep_equal(p1, p2)
+    # and the exact-mode reader consumed the stream to the same offset
+    # (no byte lost or double-read between the two implementations)
+    assert unbuffered_sock._ofs == len(blob)
+
+
+def test_buffered_reader_survives_short_reads():
+    """recv returning tiny chunks (a trickling peer) never desyncs the
+    buffered reader."""
+    frames = _sample_frames()
+    blob = b"".join(bytes(p) for f in frames for p in f)
+    reader = rpc.FrameReader(_ScriptedSock(blob, chunk=7))
+    ref_sock = _ScriptedSock(blob)
+    for _ in frames:
+        k1, p1, b1 = reader.recv_frame_ex()
+        k2, p2, b2 = rpc.recv_frame_ex(ref_sock)
+        assert k1 == k2 and b1 == b2 and _deep_equal(p1, p2)
+
+
+def test_buffered_reader_cuts_recv_syscalls_and_reports_pending():
+    """The point of the buffer: a burst that the kernel delivers in one
+    recv costs ONE syscall for every header/skeleton/plane-header field
+    (bulk plane data still recv_into's directly), where the unbuffered
+    reader pays one per field. ``pending`` flags buffered follower
+    frames so a selector loop serves them before blocking."""
+    frames = _sample_frames()
+    blob = b"".join(bytes(p) for f in frames for p in f)
+
+    greedy_sock = _ScriptedSock(blob)
+    reader = rpc.FrameReader(greedy_sock)
+    reader.recv_frame_ex()
+    assert reader.pending  # follower frames already buffered
+    for _ in frames[1:]:
+        reader.recv_frame_ex()
+    assert not reader.pending
+
+    unbuf_sock = _ScriptedSock(blob)
+    for _ in frames:
+        rpc.recv_frame_ex(unbuf_sock)
+
+    # everything after the first recv is buffered: the greedy reader does
+    # ONE recv for the whole burst (plane data was buffered too, since
+    # the single recv grabbed the full blob)
+    assert greedy_sock.recv_calls == 1
+    # the unbuffered reader pays per header/skeleton/plane-header field
+    assert unbuf_sock.recv_calls > 4 * len(frames)
+
+
+def test_buffered_reader_eof_messages_match_unbuffered():
+    frames = _sample_frames()
+    blob = b"".join(bytes(p) for p in frames[0])
+    # clean EOF before any byte
+    with pytest.raises(EOFError, match="connection closed$"):
+        rpc.FrameReader(_ScriptedSock(b"")).recv_frame_ex()
+    # EOF mid-frame
+    with pytest.raises(EOFError, match="mid-frame|mid-tensor"):
+        rpc.FrameReader(_ScriptedSock(blob[:20])).recv_frame_ex()

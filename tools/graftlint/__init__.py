@@ -14,6 +14,6 @@ hot-path/lock annotation conventions are documented in docs/LINTING.md.
 
 from tools.graftlint.core import Finding, lint_paths  # noqa: F401
 
-__version__ = "0.3.0"  # 0.3: lifecycle & durability discipline (thread-lifecycle, generation-commit, env-knob-drift, exception-classification) + suppression-rot audit + --changed
+__version__ = "0.4.0"  # 0.4: whole-program shared-state race detector (thread-root model + Eraser-style lockset analysis, atomic() markers + rot audit) alongside the DFT_RACECHECK runtime lockset witness
 
 DEFAULT_PATHS = ("distributed_faiss_tpu", "tools")

@@ -12,12 +12,13 @@ from tools.graftlint.checks import (
     locks,
     pallas_guard,
     pickle_safety,
+    races,
     recompile,
     threads,
 )
 
 ALL = (host_sync, recompile, dtype, locks, lock_order, blocking,
        frame_protocol, pallas_guard, pickle_safety, threads, durability,
-       knobs, exceptions)
+       knobs, exceptions, races)
 
 RULES = {c.RULE: c for c in ALL}

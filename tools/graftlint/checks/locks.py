@@ -47,8 +47,12 @@ PINS = {
     # thread (submit) and the batcher thread, all under the flush condition;
     # the server's tracked async-training threads live under their own lock
     ("SearchScheduler", "_queue"): "_cond",
-    ("SearchScheduler", "_counters"): "_cond",
     ("SearchScheduler", "_stopping"): "_cond",
+    # the shared atomic-counter helper (utils/atomics.py): every counter
+    # mutation and snapshot rides the bundle's own leaf lock — scheduler
+    # admission counters and client fan-out totals route through it
+    # instead of borrowing a broader lock (or an atomic() annotation)
+    ("AtomicCounters", "_counts"): "_lock",
     ("IndexServer", "_train_threads"): "_threads_lock",
     # RPC multiplexing thread state (parallel/rpc.py, parallel/server.py):
     # the client's in-flight slot table and connection generation are
@@ -74,7 +78,6 @@ PINS = {
     ("RepairQueue", "_items"): "_lock",
     ("RepairQueue", "_counters"): "_lock",
     ("IndexClient", "reroutes"): "_stats_lock",
-    ("IndexClient", "counters"): "_stats_lock",
     ("IndexClient", "_preferred"): "_stats_lock",
     # chaos query-storm collector (testing/chaos.py): results/errors are
     # appended by N storm threads and drained by stop()
@@ -152,6 +155,7 @@ PINS = {
 # some pinned classes — don't report every absent class as a stale pin
 PIN_HOMES = (
     "engine.py",
+    "utils/atomics.py",
     "serving/scheduler.py",
     "parallel/rpc.py",
     "parallel/server.py",

@@ -1,0 +1,148 @@
+"""shared-state-race: Eraser-style whole-program lockset analysis.
+
+The lock-discipline checker (checks/locks.py) polices the attributes a
+human remembered to PIN; this checker closes the gap from the other end.
+Over the shared thread-root model (core.ThreadRootModel) — every thread
+entry point the package creates (named ``threading.Thread`` targets,
+``ThreadPoolExecutor`` submissions, the public-API caller root) with an
+interprocedural lockset walk — any attribute that is WRITTEN on one root
+and touched on another with an EMPTY lockset intersection is a race
+candidate: no common lock orders the two accesses, so the interleaving
+that corrupts (or reads a torn view of) the attribute is one scheduler
+decision away. Each finding carries per-root file:line provenance for
+both sides of the offending pair.
+
+Three reviewed escape hatches, in preference order:
+
+- a PINS entry (checks/locks.py): the attribute is lock-guarded and the
+  lock-discipline checker enforces every access — pinning is the fix for
+  a real race;
+- ``# graftlint: atomic(<attr>)`` inside the class body: a benign
+  monotonic counter / publish-once flag / single-machine-word read whose
+  staleness is acceptable (CPython's GIL makes the word-tear impossible;
+  the annotation records that the STALENESS was reviewed). Prefer routing
+  counters through ``utils/atomics.AtomicCounters`` over scattering
+  these;
+- ``ok(shared-state-race)`` at the finding line: a reviewed exception
+  that is neither (rare; say why).
+
+A stale ``atomic()`` marker — one that waives no live cross-root access
+this run — is itself a finding (the atomic-rot half of the suppression
+audit), so the reviewed-benign inventory cannot rot.
+
+Precision notes: roots are a static proxy for thread identity, so two
+threads spawned from the SAME root racing each other are invisible, as
+is anything reached only through dynamic dispatch (``getattr`` RPC
+dispatch, callbacks, function values) — zero findings is necessary, not
+sufficient. ``utils/racecheck.py`` (the DFT_RACECHECK=1 runtime witness)
+covers the dynamic half of the same contract, exactly as lockdep does
+for lock-order. Cross-artifact by construction (thread roots live in
+other modules), so the whole rule gates off on subset (``--changed``)
+lints.
+"""
+
+from collections import defaultdict
+
+from tools.graftlint.core import Finding, thread_root_model
+
+RULE = "shared-state-race"
+
+
+def _atomic_map(model):
+    """((cls, attr) -> [(module, line)]) for every ``atomic()`` marker,
+    resolved to the class whose lexical span contains the comment, plus
+    the flat list of all markers for the rot audit."""
+    by_key = defaultdict(list)
+    markers = []  # (module, line, attrs, cls-or-None)
+    for mod in model.modules:
+        for line, attrs in sorted(mod.atomic_marks.items()):
+            owner = None
+            for cnode in mod.classes:
+                end = getattr(cnode, "end_lineno", cnode.lineno)
+                if cnode.lineno <= line <= end:
+                    owner = cnode.name
+                    break
+            markers.append((mod, line, attrs, owner))
+            if owner is not None:
+                for attr in attrs:
+                    by_key[(owner, attr)].append((mod, line))
+    return by_key, markers
+
+
+def _fmt_locks(locks) -> str:
+    return "{" + ", ".join(sorted(locks)) + "}" if locks else "no locks"
+
+
+def check(model):
+    if model.subset:
+        # thread roots (and the atomic-rot audit) are only decidable
+        # against the full package: a subset lint would see an attribute's
+        # accesses without the thread that races them — or a live atomic()
+        # marker as rot
+        return
+    from tools.graftlint.checks.locks import PINS
+
+    trm = thread_root_model(model)
+    by_key = defaultdict(list)
+    for acc in trm.accesses:
+        by_key[(acc.cls, acc.attr)].append(acc)
+
+    atomic_by_key, markers = _atomic_map(model)
+    used_marker_lines = set()  # (id(module), line)
+
+    for (cls, attr), accs in sorted(by_key.items()):
+        if (cls, attr) in PINS:
+            continue  # lock-guarded: lock-discipline enforces every access
+        if len({a.root for a in accs}) < 2:
+            continue
+        pair = None
+        for w in accs:
+            if not w.write:
+                continue
+            for b in accs:
+                if b.root == w.root or (w.locks & b.locks):
+                    continue
+                cand = (w, b)
+                if pair is None or (cand[0].line, cand[1].line) < (
+                        pair[0].line, pair[1].line):
+                    pair = cand
+            if pair is not None:
+                break  # accesses are sorted: the first racy write anchors
+        if pair is None:
+            continue
+        marks = atomic_by_key.get((cls, attr))
+        if marks:
+            used_marker_lines.update((id(m), ln) for m, ln in marks)
+            continue
+        w, b = pair
+        verb = "written" if b.write else "read"
+        yield Finding(
+            RULE, w.path, w.line, w.col,
+            f"{cls}.{attr} is written on root `{w.root}` "
+            f"({w.path}:{w.line} in {w.func}, {_fmt_locks(w.locks)}) and "
+            f"{verb} on root `{b.root}` ({b.path}:{b.line} in {b.func}, "
+            f"{_fmt_locks(b.locks)}) with an empty lockset intersection — "
+            "no lock orders the two threads. Pin it in the lock map "
+            "(checks/locks.py PINS), guard both sides, or annotate "
+            f"`# graftlint: atomic({attr})` for a benign monotonic "
+            "counter/flag",
+        )
+
+    # atomic-rot audit: a marker that waived nothing this run is itself a
+    # finding — exactly the ok() rot contract, for the atomic() syntax
+    for mod, line, attrs, owner in markers:
+        if (id(mod), line) in used_marker_lines:
+            continue
+        if owner is None:
+            why = ("it is outside any class body, so it can never cover "
+                   "an attribute")
+        else:
+            why = (f"no cross-root unsynchronized access to "
+                   f"{owner}.{{{', '.join(sorted(attrs))}}} exists this "
+                   "run (the race it waived was fixed, or the attr is "
+                   "gone)")
+        yield Finding(
+            RULE, mod.relpath, line, 0,
+            f"stale atomic({', '.join(sorted(attrs))}) marker: {why} — "
+            "delete it, or fix the spelling",
+        )

@@ -35,6 +35,14 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*ok\(([^)]*)\)")
 HOT_RE = re.compile(r"#\s*graftlint:\s*hot\b")
+# ``graftlint: atomic(attr[, attr2])`` comment markers — a reviewed
+# declaration that the named attribute(s) of the lexically enclosing class
+# are benign to access without a lock across threads (monotonic counters,
+# publish-once flags, single-machine-word reads whose staleness is
+# acceptable). Consumed by the shared-state-race checker; a marker that
+# waives no live cross-root access is itself a finding (the atomic-rot
+# half of the suppression audit).
+ATOMIC_RE = re.compile(r"#\s*graftlint:\s*atomic\(([^)]*)\)")
 
 # call-graph roots for the hot-path walk (module path suffix, qualname);
 # any function annotated `# graftlint: hot` is an additional root.
@@ -334,6 +342,7 @@ class ModuleInfo:
         self.tree = ast.parse(source, filename=path)
         self.suppressions: Dict[int, Set[str]] = {}
         self.hot_lines: Set[int] = set()
+        self.atomic_marks: Dict[int, Set[str]] = {}
         for i, text in self._comment_lines():
             m = SUPPRESS_RE.search(text)
             if m:
@@ -342,6 +351,11 @@ class ModuleInfo:
                 }
             if HOT_RE.search(text):
                 self.hot_lines.add(i)
+            m = ATOMIC_RE.search(text)
+            if m:
+                self.atomic_marks[i] = {
+                    a.strip() for a in m.group(1).split(",") if a.strip()
+                }
         # alias -> imported module dotted path (for internal/external calls)
         self.import_aliases: Dict[str, str] = {}
         for node in ast.walk(self.tree):
@@ -519,6 +533,336 @@ def build_model(paths: Iterable[str], subset: bool = False) -> RepoModel:
             source = f.read()
         modules.append(ModuleInfo(path, os.path.relpath(path), source))
     return RepoModel(modules, subset=subset)
+
+
+# ---------------------------------------------------------- thread-root model
+#
+# The shared-state-race checker (checks/races.py) needs a whole-program
+# answer to "which THREAD touches this attribute, holding what?". The
+# thread-root model is that answer: it enumerates every thread ENTRY POINT
+# the package creates — named ``threading.Thread`` targets (including
+# nested-def targets like the engine's save watcher), ``ThreadPoolExecutor``
+# ``submit``/``map`` callables, and the public-API caller root (the user's
+# own thread entering any public method of a lock-owning class) — then
+# walks the call graph from each root with an interprocedural LOCKSET:
+# the lexical ``with self.<lock>`` model (lock_context_events) extended by
+# held-at-entry propagation, so a helper only ever called under a lock
+# carries that lock into its accesses. Where a function is reachable under
+# several locksets within one root, the entry lockset is the INTERSECTION
+# (a lock held on every path), which is the conservative direction for
+# race detection.
+#
+# Resolution is the package's precision-first shape — bare names prefer
+# same-module definitions (else a globally unique one), ``self.m()``
+# dispatches exactly — plus one deliberate loosening shared with the
+# blocking checker: an attribute call whose method name is globally unique
+# (and not stoplisted / rooted in an external module) resolves, because
+# watcher threads reach the engine through parameters
+# (``engine.compact()``), which exact resolution cannot see. Spawn sites
+# themselves (``Thread(target=...)``, ``pool.submit(fn)``) never create a
+# same-root call edge — the callee runs on the OTHER root.
+
+API_ROOT = "api"
+
+_SPAWN_METHODS = frozenset({"submit", "map"})
+
+MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "pop", "popitem", "remove", "clear",
+    "update", "setdefault", "add", "discard", "sort", "reverse",
+})
+
+_SKIP_WALK_METHODS = frozenset({"__init__", "__new__", "__del__"})
+
+
+@dataclasses.dataclass(frozen=True)
+class SharedAccess:
+    """One ``self.<attr>`` touch attributed to a thread root."""
+
+    cls: str
+    attr: str
+    write: bool
+    path: str
+    line: int
+    col: int
+    locks: frozenset  # qualified "Cls.lock" keys held (lexical + entry)
+    root: str         # thread-root label ("api", "thread:...", "pool:...")
+    func: str         # qualname of the accessing function (provenance)
+
+
+# expressions that build a plain container: a ``.append``/``.update``-class
+# call on an attribute holding one of these is a container MUTATION (a
+# write for race purposes); the same method name on a domain object
+# (``self.membership.remove(pos)`` — MembershipTable's internally-locked
+# method) is an ordinary call and must not be misread as a torn write
+_CONTAINER_CTORS = frozenset({
+    "list", "dict", "set", "deque", "defaultdict", "OrderedDict", "Counter",
+})
+
+
+def _container_assigned_attrs(class_node) -> set:
+    """Attributes of ``self`` assigned a container literal/constructor
+    anywhere in the class body (including ``__init__``)."""
+    out = set()
+    for node in ast.walk(class_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        is_container = isinstance(v, (
+            ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+            ast.SetComp,
+        )) or (isinstance(v, ast.Call) and call_name(v) in _CONTAINER_CTORS)
+        if not is_container:
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out.add(t.attr)
+    return out
+
+
+def _is_thread_ctor_call(call: ast.Call, mod: ModuleInfo) -> bool:
+    if dotted(call.func) == "threading.Thread":
+        return True
+    if isinstance(call.func, ast.Name):
+        return mod.import_aliases.get(call.func.id) == "threading.Thread"
+    return False
+
+
+class ThreadRootModel:
+    """Thread roots + per-root shared-state accesses over one RepoModel."""
+
+    def __init__(self, model: RepoModel):
+        self.model = model
+        # label -> (kind, relpath, line): spawn-site provenance per root
+        self.roots: Dict[str, Tuple[str, str, int]] = {}
+        self.accesses: List[SharedAccess] = []
+        self._class_locks: Dict[Tuple[int, str], set] = {}
+        self._container_attrs: Dict[Tuple[int, str], set] = {}
+        for mod in model.modules:
+            for cnode in mod.classes:
+                attrs = lock_attrs(cnode)
+                if attrs:
+                    self._class_locks[(id(mod), cnode.name)] = attrs
+                self._container_attrs[(id(mod), cnode.name)] = (
+                    _container_assigned_attrs(cnode))
+        self._analyzed: Dict[int, Tuple[list, list]] = {}
+        self._fns: Dict[int, FunctionInfo] = {}
+        for label, seeds in self._enumerate_roots().items():
+            self._walk(label, seeds)
+        self.accesses.sort(key=lambda a: (a.path, a.line, a.col, a.root))
+
+    # ------------------------------------------------------------ resolution
+
+    def _ref_targets(self, expr, fi: FunctionInfo) -> List[FunctionInfo]:
+        """Functions a callable REFERENCE (a Thread target, a submit arg)
+        may denote — includes nested defs of the enclosing function (the
+        save watcher's ``_watch``), which close over the method's scope."""
+        model = self.model
+        if isinstance(expr, ast.Name):
+            for sub in ast.walk(fi.node):
+                if (isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and sub.name == expr.id and sub is not fi.node):
+                    return [FunctionInfo(
+                        fi.module, sub.name, f"{fi.qualname}.{sub.name}",
+                        fi.cls, sub)]
+            cands = model.by_name.get(expr.id, [])
+            same = [g for g in cands if g.module is fi.module]
+            if same:
+                return same
+            return list(cands) if len(cands) == 1 else []
+        if isinstance(expr, ast.Attribute):
+            if (isinstance(expr.value, ast.Name) and expr.value.id == "self"
+                    and fi.cls is not None):
+                exact = [g for g in model.by_name.get(expr.attr, ())
+                         if g.module is fi.module and g.cls == fi.cls]
+                if exact:
+                    return exact
+            if attr_root(expr) in EXTERNAL_ROOTS:
+                return []
+            if expr.attr in HOT_EDGE_STOPLIST:
+                return []
+            cands = model.by_name.get(expr.attr, [])
+            return list(cands) if len(cands) == 1 else []
+        return []
+
+    def _call_targets(self, call: ast.Call, fi: FunctionInfo):
+        """Same-root callees of one call site (spawn sites excluded: their
+        callable runs on the root the spawn created, not this one). Bare
+        names resolve same-module-first (never into nested defs — those
+        are already walked inline by the lexical model)."""
+        f = call.func
+        if (isinstance(f, ast.Attribute) and f.attr in _SPAWN_METHODS
+                and call.args and self._ref_targets(call.args[0], fi)):
+            return []
+        if isinstance(f, ast.Name):
+            if f.id in HOT_EDGE_STOPLIST:
+                return []
+            cands = self.model.by_name.get(f.id, [])
+            same = [g for g in cands if g.module is fi.module]
+            if same:
+                return same
+            return list(cands) if len(cands) == 1 else []
+        if isinstance(f, ast.Attribute):
+            return self._ref_targets(f, fi)
+        return []
+
+    # ------------------------------------------------------------ enumeration
+
+    def _enumerate_roots(self) -> Dict[str, List[FunctionInfo]]:
+        seeds: Dict[str, List[FunctionInfo]] = defaultdict(list)
+        seen_nodes: Dict[str, Set[int]] = defaultdict(set)
+
+        def add(label, kind, fn, relpath, line):
+            if id(fn.node) in seen_nodes[label]:
+                return
+            seen_nodes[label].add(id(fn.node))
+            self.roots.setdefault(label, (kind, relpath, line))
+            seeds[label].append(fn)
+
+        for fi in self.model.functions:
+            # the public-API caller root: a user thread may enter any
+            # public method of a lock-owning class (and any public
+            # module-level function) directly
+            public = not fi.name.startswith("_")
+            if public and (fi.cls is None or (id(fi.module), fi.cls)
+                           in self._class_locks):
+                add(API_ROOT, "api", fi, fi.module.relpath, fi.lineno)
+            for sub in ast.walk(fi.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _is_thread_ctor_call(sub, fi.module):
+                    target = next((kw.value for kw in sub.keywords
+                                   if kw.arg == "target"), None)
+                    if target is None:
+                        continue
+                    for g in self._ref_targets(target, fi):
+                        add(f"thread:{g.qualname}", "thread", g,
+                            fi.module.relpath, sub.lineno)
+                elif (isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in _SPAWN_METHODS and sub.args
+                        and attr_root(sub.func) not in EXTERNAL_ROOTS):
+                    for g in self._ref_targets(sub.args[0], fi):
+                        add(f"pool:{g.qualname}", "pool", g,
+                            fi.module.relpath, sub.lineno)
+        return seeds
+
+    # ------------------------------------------------------------ the walk
+
+    def _analyze(self, fn: FunctionInfo):
+        """Cached per-function scan: (raw accesses, raw call edges), each
+        carrying the LEXICALLY held own-class locks at the site."""
+        cached = self._analyzed.get(id(fn.node))
+        if cached is not None:
+            return cached
+        lock_names = self._class_locks.get(
+            (id(fn.module), fn.cls), frozenset()) if fn.cls else frozenset()
+        containers = self._container_attrs.get(
+            (id(fn.module), fn.cls), frozenset()) if fn.cls else frozenset()
+        accesses: list = []   # (attr, write, line, col, held-tuple)
+        calls: list = []      # (callee FunctionInfo, held-tuple)
+        skip_reads: Set[int] = set()  # inner attr nodes of write wrappers
+
+        for ev in lock_context_events(fn.node, lock_names):
+            if ev[0] != "node":
+                continue
+            _, node, held = ev
+            if isinstance(node, ast.Attribute):
+                if not (isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    continue
+                attr = node.attr
+                if (attr in lock_names or attr.startswith("__")):
+                    continue
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    accesses.append((attr, True, node.lineno,
+                                     node.col_offset, held))
+                elif id(node) not in skip_reads:
+                    accesses.append((attr, False, node.lineno,
+                                     node.col_offset, held))
+            elif isinstance(node, ast.Subscript) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                base = node.value
+                if (isinstance(base, ast.Attribute)
+                        and isinstance(base.value, ast.Name)
+                        and base.value.id == "self"
+                        and base.attr not in lock_names):
+                    accesses.append((base.attr, True, node.lineno,
+                                     node.col_offset, held))
+                    skip_reads.add(id(base))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in MUTATOR_METHODS
+                        and isinstance(f.value, ast.Attribute)
+                        and isinstance(f.value.value, ast.Name)
+                        and f.value.value.id == "self"
+                        and f.value.attr in containers
+                        and f.value.attr not in lock_names):
+                    accesses.append((f.value.attr, True, node.lineno,
+                                     node.col_offset, held))
+                    skip_reads.add(id(f.value))
+                for g in self._call_targets(node, fn):
+                    if g.name not in _SKIP_WALK_METHODS:
+                        calls.append((g, held))
+        result = (accesses, calls)
+        self._analyzed[id(fn.node)] = result
+        self._fns[id(fn.node)] = fn
+        return result
+
+    def _walk(self, label: str, seeds: List[FunctionInfo]) -> None:
+        def qualify(fn, held):
+            return frozenset(f"{fn.cls}.{h}" for h in held)
+
+        entry: Dict[int, frozenset] = {}
+        fns: Dict[int, FunctionInfo] = {}
+        work: List[FunctionInfo] = []
+        for fn in seeds:
+            entry[id(fn.node)] = frozenset()
+            fns[id(fn.node)] = fn
+            work.append(fn)
+        # phase 1: propagate held-at-entry locksets to a fixpoint
+        # (intersection merge — only a lock held on EVERY path counts)
+        while work:
+            fn = work.pop()
+            eff_base = entry[id(fn.node)]
+            _, calls = self._analyze(fn)
+            for g, held in calls:
+                eff = eff_base | qualify(fn, held)
+                cur = entry.get(id(g.node))
+                if cur is None:
+                    entry[id(g.node)] = eff
+                    fns[id(g.node)] = g
+                    work.append(g)
+                else:
+                    merged = cur & eff
+                    if merged != cur:
+                        entry[id(g.node)] = merged
+                        work.append(fns[id(g.node)])
+        # phase 2: record every self.<attr> access with its final lockset.
+        # Scope: methods of LOCK-OWNING classes only (the lock-discipline
+        # scope) — lock-less helper classes (frame decode cursors, the
+        # tombstone set) are either method-local or reached exclusively
+        # through a lock-owning owner whose pinned attribute already
+        # carries the guarantee
+        for nid, base in entry.items():
+            fn = fns[nid]
+            if fn.cls is None or (
+                    id(fn.module), fn.cls) not in self._class_locks:
+                continue
+            accesses, _ = self._analyze(fn)
+            for attr, write, line, col, held in accesses:
+                self.accesses.append(SharedAccess(
+                    fn.cls, attr, write, fn.module.relpath, line, col,
+                    frozenset(base | qualify(fn, held)), label, fn.qualname))
+
+
+def thread_root_model(model: RepoModel) -> ThreadRootModel:
+    """The (memoized) thread-root model for one RepoModel."""
+    cached = getattr(model, "_thread_root_model", None)
+    if cached is None:
+        cached = ThreadRootModel(model)
+        model._thread_root_model = cached
+    return cached
 
 
 SUPPRESSION_AUDIT_RULE = "unused-suppression"
