@@ -40,7 +40,7 @@ from distributed_faiss_tpu.observability import spans as obs_spans
 from distributed_faiss_tpu.mutation import tombstones as _tombstones
 from distributed_faiss_tpu.mutation import versions as _versions
 from distributed_faiss_tpu.mutation.tombstones import TombstoneSet
-from distributed_faiss_tpu.utils import envutil, lockdep, serialization
+from distributed_faiss_tpu.utils import envutil, lockdep, serialization, xfercheck
 from distributed_faiss_tpu.utils.batching import SearchBatcher
 from distributed_faiss_tpu.utils.config import (
     IndexCfg,
@@ -1555,7 +1555,12 @@ class Index:
         for _ in range(8):
             with self.buffer_lock:
                 epoch0 = self._meta_epoch
-            scores, indexes, embs_arr = run()
+            # DFT_XFERCHECK=1: the launch-to-fetch span is a guarded
+            # hot-path section — data crosses the device boundary only
+            # through explicit feeds (device_put) and the explicit()
+            # fetch scopes down in the blocked-search drivers
+            with xfercheck.guarded("engine launch-to-fetch span"):
+                scores, indexes, embs_arr = run()
             with self.buffer_lock:
                 if self._meta_epoch != epoch0:
                     continue  # layout swapped mid-flight: retry on the new one
@@ -1706,7 +1711,11 @@ class Index:
                 rec = np.zeros((flat.shape[0], query_batch.shape[1]), np.float32)
             else:
                 safe = np.where(flat >= 0, flat, 0)
-                rec = np.array(self.tpu_index.reconstruct_batch(safe))
+                # designed host round-trip (the ok(host-sync) contract:
+                # reconstruct returns host rows), marked explicit for the
+                # transfer guard
+                with xfercheck.explicit("reconstruct embeddings fetch"):
+                    rec = np.array(self.tpu_index.reconstruct_batch(safe))
                 rec[flat < 0] = 0.0
             embs_arr = rec.reshape(indexes.shape + (query_batch.shape[1],))
         return scores, indexes, embs_arr

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_faiss_tpu.ops import distance
+from distributed_faiss_tpu.utils import xfercheck
 
 
 def _next_pow2(n: int, minimum: int) -> int:
@@ -481,17 +482,25 @@ def blocked_search(q: np.ndarray, k: int, metric: str, fn, block: int = 256,
     """
     q = np.asarray(q, np.float32)
     nq = q.shape[0]
+    # Feeds go through explicit jax.device_put and fetches through an
+    # xfercheck.explicit() scope: the serving path runs under
+    # DFT_XFERCHECK's transfer guard, which forbids the implicit
+    # host<->device copies jnp.asarray/np.asarray would otherwise hide
+    # at the jit boundary. (Mesh callers re-place the block onto their
+    # sharding inside fn/fused_fn — also explicitly.)
     if fused_fn is not None and nq > block:
         nblocks = _next_pow2(-(-nq // block), 1)
         qp = np.pad(q, ((0, nblocks * block - nq), (0, 0)))
-        vals, ids = fused_fn(jnp.asarray(qp.reshape(nblocks, block, -1)))
-        out_s = np.asarray(vals).reshape(nblocks * block, -1)[:nq]
-        out_i = np.asarray(ids).reshape(nblocks * block, -1)[:nq].astype(np.int64)
+        vals, ids = fused_fn(jax.device_put(qp.reshape(nblocks, block, -1)))
+        with xfercheck.explicit("blocked_search fused result fetch"):
+            out_s = np.asarray(vals).reshape(nblocks * block, -1)[:nq]
+            out_i = np.asarray(ids).reshape(nblocks * block, -1)[:nq].astype(np.int64)
         return finalize_results(out_s, out_i, metric)
     out_s = np.empty((nq, k), np.float32)
     out_i = np.empty((nq, k), np.int64)
     for s, n, chunk in query_blocks(q, block):
-        vals, ids = fn(jnp.asarray(chunk))
-        out_s[s : s + n] = np.asarray(vals)[:n]
-        out_i[s : s + n] = np.asarray(ids)[:n]
+        vals, ids = fn(jax.device_put(chunk))
+        with xfercheck.explicit("blocked_search block result fetch"):
+            out_s[s : s + n] = np.asarray(vals)[:n]
+            out_i[s : s + n] = np.asarray(ids)[:n]
     return finalize_results(out_s, out_i, metric)

@@ -23,7 +23,7 @@ import numpy as np
 
 from distributed_faiss_tpu.models import base
 from distributed_faiss_tpu.ops import distance, sq
-from distributed_faiss_tpu.utils import sanitize
+from distributed_faiss_tpu.utils import sanitize, xfercheck
 
 _CODEC_DTYPES = {
     "f32": jnp.float32,
@@ -105,16 +105,20 @@ class FlatIndex(base.TpuIndex):
             # fused variants, not one per distinct batch size
             nblocks = base._next_pow2(-(-nq // nb), 1)
             qp = np.pad(q, ((0, nblocks * nb - nq), (0, 0)))
+            # explicit device_put feeds: the serving path runs under
+            # DFT_XFERCHECK's transfer guard, which forbids the implicit
+            # uploads jnp.asarray/jit-dispatch would do here
             vals, ids = sanitize.maybe_checked(
                 _flat_search_fused,
-                jnp.asarray(qp.reshape(nblocks, nb, -1)), self.store.data,
-                jnp.asarray(self.store.ntotal, jnp.int32), k=k,
+                jax.device_put(qp.reshape(nblocks, nb, -1)), self.store.data,
+                jax.device_put(np.int32(self.store.ntotal)), k=k,
                 metric=self.metric, codec=self.codec,
                 vmin=kwargs.get("vmin"), span=kwargs.get("span"),
                 live=self.store.live,
             )
-            out_s = np.asarray(vals).reshape(nblocks * nb, -1)[:nq]
-            out_i = np.asarray(ids).reshape(nblocks * nb, -1)[:nq].astype(np.int64)
+            with xfercheck.explicit("flat fused-search result fetch"):
+                out_s = np.asarray(vals).reshape(nblocks * nb, -1)[:nq]
+                out_i = np.asarray(ids).reshape(nblocks * nb, -1)[:nq].astype(np.int64)
             return base.finalize_results(out_s, out_i, self.metric)
         out_s = np.empty((nq, k), np.float32)
         out_i = np.empty((nq, k), np.int64)
@@ -123,8 +127,9 @@ class FlatIndex(base.TpuIndex):
                 block, self.store.data, k, metric=self.metric,
                 ntotal=self.store.ntotal, live=self.store.live, **kwargs
             )
-            out_s[s : s + n] = np.asarray(vals)[:n]
-            out_i[s : s + n] = np.asarray(ids)[:n]
+            with xfercheck.explicit("flat block-search result fetch"):
+                out_s[s : s + n] = np.asarray(vals)[:n]
+                out_i[s : s + n] = np.asarray(ids)[:n]
         return base.finalize_results(out_s, out_i, self.metric)
 
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:

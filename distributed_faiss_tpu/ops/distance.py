@@ -214,6 +214,12 @@ def knn(q, x, k: int, metric: str = "l2", ntotal=None, chunk: int = 65536,
     (cap,) bool tombstone mask (False = deleted, masked like padding);
     None runs the exact pre-mutation program.
     """
+    # explicit feeds: host query batches (and the host ntotal scalar
+    # below) are uploaded via device_put, not left for jit dispatch to
+    # transfer implicitly — the serving path runs under DFT_XFERCHECK's
+    # transfer guard, which forbids the implicit form
+    if not isinstance(q, jax.Array):
+        q = jax.device_put(np.asarray(q, np.float32))
     cap = x.shape[0]
     if ntotal is None:
         ntotal = cap
@@ -225,8 +231,13 @@ def knn(q, x, k: int, metric: str = "l2", ntotal=None, chunk: int = 65536,
         x = jnp.pad(x, ((0, newcap - cap), (0, 0)))
         if live is not None:
             live = jnp.pad(live, (0, newcap - cap))
+    # device_put, not jnp.asarray: ntotal is usually a host int, and the
+    # serving path runs under DFT_XFERCHECK's transfer guard — the upload
+    # must be an explicit transfer, not an implicit one at jit dispatch
+    if not isinstance(ntotal, jax.Array):
+        ntotal = jax.device_put(np.int32(ntotal))
     # maybe_checked: GRAFT_SANITIZE=1 runs the scan under checkify
     # (NaN + OOB-gather checks); identity passthrough otherwise
     return sanitize.maybe_checked(
-        _knn_scan, q, x, jnp.asarray(ntotal, jnp.int32), k=k, metric=metric,
+        _knn_scan, q, x, ntotal, k=k, metric=metric,
         chunk=chunk, codec=codec, vmin=vmin, span=span, live=live)

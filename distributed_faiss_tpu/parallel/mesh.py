@@ -81,6 +81,7 @@ from distributed_faiss_tpu.models import base
 from distributed_faiss_tpu.models import ivf as ivfmod
 from distributed_faiss_tpu.models.ivf import IVFFlatIndex, IVFPQIndex, probe_group_size
 from distributed_faiss_tpu.ops import distance
+from distributed_faiss_tpu.utils import xfercheck
 
 _HIGHEST = jax.lax.Precision.HIGHEST
 logger = logging.getLogger(__name__)
@@ -307,6 +308,15 @@ def _counted(index, call):
     return wrapped
 
 
+def _replicated(mesh, arr):
+    """Explicitly replicate a host block / single-device array onto the
+    mesh. The sharded jit entries would do the same reshard implicitly at
+    dispatch, but the serving path runs under DFT_XFERCHECK's transfer
+    guard, which (rightly) flags implicit cross-device placement — the
+    query feed is a designed transfer, so make it one."""
+    return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
 # --------------------------------------------------------------- index models
 
 
@@ -401,6 +411,13 @@ class ShardedFlatIndex(base.TpuIndex):
     def _sync(self) -> None:
         if self._synced_n == self._n and self._dev is not None:
             return
+        # designed host->device landing (pending rows cross to the mesh
+        # here and only here): mark the whole sync explicit so a search
+        # that triggers it under DFT_XFERCHECK's guard stays legal
+        with xfercheck.explicit("sharded corpus sync: land host-pending rows"):
+            self._sync_locked()
+
+    def _sync_locked(self) -> None:
         S = self.nshards
         n_new = self._n - self._synced_n
         bucket = base._next_pow2(max(n_new, 1), base.DeviceVectorStore.WRITE_BUCKET)
@@ -485,12 +502,12 @@ class ShardedFlatIndex(base.TpuIndex):
         return base.blocked_search(
             q, k, self.metric,
             _counted(self, lambda b: _sharded_knn_jit(
-                b, self._dev, self._ntotals, self.mesh, k, self.metric,
-                chunk, live=self._live)),
+                _replicated(self.mesh, b), self._dev, self._ntotals,
+                self.mesh, k, self.metric, chunk, live=self._live)),
             block=base.pick_query_block(65536 * 4),
             fused_fn=_counted(self, lambda q3: _sharded_knn_fused(
-                q3, self._dev, self._ntotals, self.mesh, k, self.metric,
-                chunk, live=self._live)),
+                _replicated(self.mesh, q3), self._dev, self._ntotals,
+                self.mesh, k, self.metric, chunk, live=self._live)),
         )
 
     def reconstruct_batch(self, ids: np.ndarray) -> np.ndarray:
@@ -952,6 +969,10 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
     def search(self, q: np.ndarray, k: int):
         if self._n == 0:
             return self._empty_results(q.shape[0], k)
+        # snapshot restore leaves centroids single-device; the sharded
+        # entries consume them replicated — re-place explicitly (no-op
+        # once cached; see ShardedIVFPQIndex.search)
+        self.centroids = _replicated(self.mesh, self.centroids)
         nprobe = min(self.nprobe, self.nlist)
         norms = self._scan_norms()
         refining = bool(self.refine_k_factor) and self.raw_lists is not None
@@ -966,8 +987,9 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
                 self, q, k, nprobe, group,
                 _counted(self, lambda block, n, bucket: _sharded_ivf_flat_search_routed(
                     self.centroids, self.lists.data, self.lists.ids,
-                    self.lists.sizes, block, n, self.mesh, k, nprobe, bucket,
-                    group, self.metric, list_norms=norms,
+                    self.lists.sizes, _replicated(self.mesh, block),
+                    _replicated(self.mesh, np.int32(n)), self.mesh, k, nprobe,
+                    bucket, group, self.metric, list_norms=norms,
                     scan_bf16=self.scan_bf16, adc_k=adc_k, raw_data=raw,
                 )),
                 local_k=adc_k or k,
@@ -978,13 +1000,15 @@ class ShardedIVFFlatIndex(IVFFlatIndex):
             q, k,
             _counted(self, lambda b: _sharded_ivf_flat_search(
                 self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
-                b, self.mesh, k, nprobe, gsz, self.metric, list_norms=norms,
+                _replicated(self.mesh, b), self.mesh, k, nprobe, gsz,
+                self.metric, list_norms=norms,
                 scan_bf16=self.scan_bf16, adc_k=adc_k, raw_data=raw,
             )),
             block=nb,
             fused_fn=_counted(self, lambda q3: _sharded_ivf_flat_search_fused(
                 self.centroids, self.lists.data, self.lists.ids, self.lists.sizes,
-                q3, self.mesh, k, nprobe, gsz, self.metric, list_norms=norms,
+                _replicated(self.mesh, q3), self.mesh, k, nprobe, gsz,
+                self.metric, list_norms=norms,
                 scan_bf16=self.scan_bf16, adc_k=adc_k, raw_data=raw,
             )),
         )
@@ -1246,6 +1270,13 @@ class ShardedIVFPQIndex(IVFPQIndex):
     def search(self, q: np.ndarray, k: int):
         if self._n == 0:
             return self._empty_results(q.shape[0], k)
+        # the parent's PQ training (and snapshot restore) leaves codebooks
+        # and centroids as single-device arrays; the sharded entries
+        # consume them replicated. Re-place them explicitly — an implicit
+        # reshard at jit dispatch is exactly what DFT_XFERCHECK forbids —
+        # and cache the placement (device_put no-ops once they match).
+        self.codebooks = _replicated(self.mesh, self.codebooks)
+        self.centroids = _replicated(self.mesh, self.centroids)
         nprobe = min(self.nprobe, self.nlist)
         refining = bool(self.refine_k_factor) and self.raw_lists is not None
         if refining:
@@ -1262,7 +1293,9 @@ class ShardedIVFPQIndex(IVFPQIndex):
         def run_routed(block, n, bucket, pallas_on):
             return _sharded_ivf_pq_search_routed(
                 self.centroids, self.codebooks, self.lists.data,
-                self.lists.ids, self.lists.sizes, block, n, self.mesh, k,
+                self.lists.ids, self.lists.sizes,
+                _replicated(self.mesh, block),
+                _replicated(self.mesh, np.int32(n)), self.mesh, k,
                 nprobe, bucket, group, self.metric, use_pallas=pallas_on,
                 adc_k=adc_k, raw_data=raw,
                 lut_bf16=pallas_on and self.adc_lut_bf16,
@@ -1277,7 +1310,8 @@ class ShardedIVFPQIndex(IVFPQIndex):
                 ivfmod.pq_probe_payload_bytes(self.lists.cap, self.m, nq_block=nb))
             return _sharded_ivf_pq_search(
                 self.centroids, self.codebooks, self.lists.data, self.lists.ids,
-                self.lists.sizes, b, self.mesh, k, nprobe, g, self.metric,
+                self.lists.sizes, _replicated(self.mesh, b), self.mesh, k,
+                nprobe, g, self.metric,
                 use_pallas=pallas_on, adc_k=adc_k, raw_data=raw,
                 lut_bf16=pallas_on and self.adc_lut_bf16,
             )
@@ -1307,7 +1341,8 @@ class ShardedIVFPQIndex(IVFPQIndex):
                 ivfmod.pq_probe_payload_bytes(self.lists.cap, self.m, nq_block=nb))
             return _sharded_ivf_pq_search_fused(
                 self.centroids, self.codebooks, self.lists.data, self.lists.ids,
-                self.lists.sizes, q3, self.mesh, k, nprobe, g, self.metric,
+                self.lists.sizes, _replicated(self.mesh, q3), self.mesh, k,
+                nprobe, g, self.metric,
                 use_pallas=pallas_on, adc_k=adc_k, raw_data=raw,
                 lut_bf16=pallas_on and self.adc_lut_bf16,
             )
@@ -1719,8 +1754,12 @@ def _routed_search_blocks(index, q, k: int, nprobe: int, group: int, call,
         hard_cap = -(-bq * nprobe // group) * group
         bucket = min(routed_pair_bucket(bq, nprobe, S, group, slack), hard_cap)
         while True:
-            vals, ids, dropped = call(jnp.asarray(block), n, bucket)
-            nd = int(dropped)
+            # the raw numpy block goes through; call() device_puts it
+            # onto the mesh explicitly (_replicated) so the feed passes
+            # DFT_XFERCHECK's transfer guard
+            vals, ids, dropped = call(block, n, bucket)
+            with xfercheck.explicit("routed drop-count readback"):
+                nd = int(dropped)
             if nd == 0 or bucket >= hard_cap:
                 break
             bucket = min(2 * bucket, hard_cap)
@@ -1734,8 +1773,9 @@ def _routed_search_blocks(index, q, k: int, nprobe: int, group: int, call,
                 "probe routing still dropped %d pairs at the full-pair "
                 "bucket; results may lose recall", nd,
             )
-        out_s[s0:s0 + n] = np.asarray(vals)[:n]
-        out_i[s0:s0 + n] = np.asarray(ids)[:n]
+        with xfercheck.explicit("routed block result fetch"):
+            out_s[s0:s0 + n] = np.asarray(vals)[:n]
+            out_i[s0:s0 + n] = np.asarray(ids)[:n]
     index._routed_slack = slack
     return base.finalize_results(out_s, out_i, index.metric)
 

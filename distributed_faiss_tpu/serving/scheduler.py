@@ -59,7 +59,7 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 from distributed_faiss_tpu.observability import spans as obs_spans
-from distributed_faiss_tpu.utils import lockdep
+from distributed_faiss_tpu.utils import lockdep, xfercheck
 from distributed_faiss_tpu.utils.atomics import AtomicCounters
 from distributed_faiss_tpu.utils.config import SchedulerCfg
 from distributed_faiss_tpu.utils.tracing import LatencyStats
@@ -400,8 +400,12 @@ class SearchScheduler:
                 obs_spans.set_current_trace(traced[0].trace_id)
                 launch_w0, launch_p0 = time.time(), time.perf_counter()
             try:
-                result = self._search_fn(
-                    head.index_id, qcat, head.k, head.return_embeddings)
+                # DFT_XFERCHECK=1 arms jax's transfer guard for the whole
+                # merged-window launch: any implicit host<->device copy in
+                # the flush fails the provoking request with provenance
+                with xfercheck.guarded("scheduler merge-window flush"):
+                    result = self._search_fn(
+                        head.index_id, qcat, head.k, head.return_embeddings)
             finally:
                 if traced:
                     launch_dt = time.perf_counter() - launch_p0

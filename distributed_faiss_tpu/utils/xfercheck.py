@@ -1,0 +1,173 @@
+"""Runtime implicit-transfer witness (DFT_XFERCHECK=1): jax transfer
+guards armed around the serving hot path.
+
+The IR tier (tools/graftlint/ir) proves the registered *programs* stay
+on-device; what it cannot see is the dispatch boundary — a numpy operand
+silently uploaded per launch, a single-device array implicitly resharded
+onto the mesh, a device result pulled to host mid-window. This module is
+the fourth sibling of ``utils/lockdep.py`` / ``utils/threadcheck.py`` /
+``utils/racecheck.py``:
+
+- ``guarded(label)`` (a no-op unless DFT_XFERCHECK=1) arms
+  ``jax.transfer_guard("disallow")`` for the calling thread around a
+  serving hot-path section — the scheduler's window flush and the
+  engine's launch-to-fetch span wear it. Any *implicit* transfer inside
+  raises; the witness records provenance (label, direction, repo
+  file:line) and re-raises ``ImplicitTransferError``.
+- ``explicit(reason)`` marks a DESIGNED host fetch/feed — the same sites
+  that carry ``# graftlint: ok(host-sync)`` — by allowing transfers for
+  its extent when a guard is armed on this thread (zero-cost otherwise).
+  Explicit-API transfers (``jax.device_put`` with a destination,
+  ``jax.device_get``) are allowed by "disallow" already; the hot paths
+  use those for their designed feeds, so ``explicit()`` is only needed
+  where a *fetch region* genuinely round-trips (result unpacking,
+  reconstruct, persistence).
+- a conftest fixture (tests/conftest.py) drains recorded violations
+  after every test, so a raise swallowed by a serving loop's broad
+  except still fails the test that provoked it (the racecheck pattern).
+
+``DFT_XFERCHECK_SCOPE`` picks the guarded directions: ``all`` (default),
+``d2h``, or ``h2d``. On the CPU test platform only implicit
+host-to-device transfers at jit dispatch are physically guarded (host
+buffers are zero-copy), so CI arms ``all`` and relies on TPU runs for
+the device-to-host class; the witness API is identical on both.
+
+Disabled (the default), ``guarded``/``explicit`` never import-touch jax
+config: zero overhead, byte-identical behavior.
+"""
+
+import contextlib
+import os
+import threading
+import traceback
+
+from distributed_faiss_tpu.utils import envutil
+
+__all__ = [
+    "ImplicitTransferError", "enabled", "scope", "guarded", "explicit",
+    "drain", "check", "reset", "armed",
+]
+
+
+class ImplicitTransferError(AssertionError):
+    """An implicit device<->host (or cross-device) transfer happened
+    inside a guarded serving section: the hot path silently moved data."""
+
+
+def enabled() -> bool:
+    """DFT_XFERCHECK master switch, read per call (tests flip it
+    per-fixture; subprocess tiers inherit it)."""
+    return envutil.env_flag("DFT_XFERCHECK", False)
+
+
+def scope() -> str:
+    """DFT_XFERCHECK_SCOPE: which transfer directions the armed guard
+    disallows — "all" (default), "d2h", or "h2d"."""
+    val = envutil.env_str("DFT_XFERCHECK_SCOPE", "all")
+    return val if val in ("all", "d2h", "h2d") else "all"
+
+
+# _MU is a strict leaf guarding _VIOLATIONS (the racecheck discipline:
+# nothing else is ever acquired while it is held).
+_MU = threading.Lock()
+_VIOLATIONS = []  # formatted messages, drained by the conftest fixture
+_TLS = threading.local()
+
+
+def armed() -> bool:
+    """True when a guarded() section is active on THIS thread."""
+    return getattr(_TLS, "depth", 0) > 0
+
+
+def _is_transfer_error(exc) -> bool:
+    s = str(exc)
+    return "Disallowed" in s and "transfer" in s
+
+
+def _provenance(exc) -> str:
+    """Deepest repo frame of the raising traceback (the provoking line)."""
+    site = "<unknown>"
+    for fr in traceback.extract_tb(exc.__traceback__):
+        if "distributed_faiss_tpu" in fr.filename:
+            site = f"{os.path.basename(fr.filename)}:{fr.lineno}"
+    return site
+
+
+@contextlib.contextmanager
+def guarded(label: str):
+    """Arm the transfer guard around a serving hot-path section. Nests
+    (scheduler flush wraps the engine launch); the innermost section
+    records and converts the violation."""
+    if not enabled():
+        yield
+        return
+    import jax
+
+    guards = {
+        "all": jax.transfer_guard,
+        "d2h": jax.transfer_guard_device_to_host,
+        "h2d": jax.transfer_guard_host_to_device,
+    }[scope()]
+    _TLS.depth = getattr(_TLS, "depth", 0) + 1
+    try:
+        with guards("disallow"):
+            try:
+                yield
+            except Exception as exc:
+                if isinstance(exc, ImplicitTransferError):
+                    raise  # already recorded by a nested section
+                if not _is_transfer_error(exc):
+                    raise
+                msg = (
+                    f"xfercheck: implicit transfer inside guarded "
+                    f"section {label!r} (thread "
+                    f"{threading.current_thread().name!r}, scope "
+                    f"{scope()!r}) at {_provenance(exc)}: {exc}. The "
+                    "serving hot path must move data only through "
+                    "explicit device_put/device_get feeds or an "
+                    "explicit(reason) fetch scope (the ok(host-sync) "
+                    "sites)."
+                )
+                with _MU:
+                    _VIOLATIONS.append(msg)
+                raise ImplicitTransferError(msg) from exc
+    finally:
+        _TLS.depth -= 1
+
+
+@contextlib.contextmanager
+def explicit(reason: str):
+    """A designed host fetch/feed region (shared with the ok(host-sync)
+    sites): transfers inside are allowed even while a guard is armed on
+    this thread. No-op — no jax import — when nothing is armed."""
+    if not armed():
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard("allow"):
+        yield
+
+
+def drain():
+    """Return-and-clear the recorded violations (the conftest fixture's
+    per-test read side — a raise swallowed by a serving loop still fails
+    the test that provoked it)."""
+    with _MU:
+        out = list(_VIOLATIONS)
+        _VIOLATIONS.clear()
+    return out
+
+
+def check() -> None:
+    """Raise if any violation was recorded since the last drain."""
+    leaks = drain()
+    if leaks:
+        raise ImplicitTransferError(
+            "%d implicit transfer(s) witnessed:\n%s"
+            % (len(leaks), "\n".join(leaks)))
+
+
+def reset() -> None:
+    """Clear recorded violations (test isolation)."""
+    drain()

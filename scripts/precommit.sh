@@ -27,4 +27,13 @@ echo "racecheck: runtime shared-state race witness unit tests"
 JAX_PLATFORMS=cpu python -m pytest tests/test_racecheck.py -q \
     -m "racecheck and not slow" -p no:cacheprovider
 
+echo "xfercheck/compilecheck: runtime transfer + compile witness unit tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_xfercheck.py \
+    tests/test_compilecheck.py -q \
+    -m "(xfercheck or compilecheck) and not slow" -p no:cacheprovider
+
+echo "graftlint IR tier: registry trace + golden jaxpr fixtures"
+JAX_PLATFORMS=cpu python -m pytest tests/test_graftlint_ir.py -q \
+    -m "ir and not slow" -p no:cacheprovider
+
 echo "precommit: OK"

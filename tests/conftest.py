@@ -32,7 +32,8 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
-from distributed_faiss_tpu.utils import racecheck, threadcheck
+from distributed_faiss_tpu.utils import (compilecheck, racecheck, threadcheck,
+                                         xfercheck)
 
 # DFT_THREADCHECK=1: wrap Thread.start once, at collection time, so every
 # thread started anywhere in the suite carries creation provenance
@@ -44,6 +45,13 @@ if threadcheck.enabled():
 # birth (utils/racecheck.py; implies lockdep's held-lockset tracking)
 if racecheck.enabled():
     racecheck.install()
+
+# DFT_COMPILECHECK=1: hook jax's lowering logger once, at collection time,
+# so every XLA compilation anywhere in the suite lands in the per-entry
+# tally (utils/compilecheck.py; the zero-new-compiles-after-warmup
+# assertions read it via snapshot()/new_since())
+if compilecheck.enabled():
+    compilecheck.install()
 
 
 @pytest.fixture(autouse=True)
@@ -78,6 +86,23 @@ def _shared_state_race_witness():
     racecheck.drain()
     yield
     racecheck.check()
+
+
+@pytest.fixture(autouse=True)
+def _implicit_transfer_witness():
+    """DFT_XFERCHECK=1 runtime witness (utils/xfercheck.py): any implicit
+    host<->device transfer recorded inside a guarded serving section
+    during this test fails it — including violations whose in-thread
+    ImplicitTransferError the scheduler's broad per-request error
+    routing swallowed. Earlier tests' violations are drained up front so
+    blame lands on the test that provoked the transfer. No-op when the
+    knob is off."""
+    if not xfercheck.enabled():
+        yield
+        return
+    xfercheck.drain()
+    yield
+    xfercheck.check()
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,51 @@
+"""Doctored IR-tier fixture: every jaxpr rule fires, with pinned lines.
+
+Loaded by tests/test_graftlint_ir.py via importlib (never imported by the
+package) and fed to ``lint_ir`` through fixture rows carrying the
+callables directly.  Line numbers are asserted exactly — keep the layout
+stable or update the golden expectations.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# 513 * 512 * 4 = 1,050,624 bytes: just over the 1 MiB const limit
+_BIG = np.ones((513, 512), np.float32)
+
+
+def _leak(x):
+    return np.asarray(x).sum()
+
+
+def _host_norm(x):
+    return np.linalg.norm(np.asarray(x), axis=-1)
+
+
+@jax.jit
+def residency_bad(x):
+    jax.debug.callback(_leak, x)  # line 28: host callback inside the program
+    return x * 2.0
+
+
+@jax.jit
+def callback_bad(x):
+    # line 35: pure_callback whose target is not in the allowlist
+    return jax.pure_callback(
+        _host_norm, jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+
+@jax.jit
+def dtype_bad(a, b):
+    return jnp.dot(a, b)  # line 41: bf16 x bf16 accumulating in bf16
+
+
+@jax.jit
+def const_bad(x):  # anchored at the @jax.jit line above (44): def-site rule
+    return x + _BIG  # the weight-sized array is baked in as a const
+
+
+@jax.jit
+def unregistered(x):  # line 50: module-level jit entry with no registry row
+    return x * 3.0

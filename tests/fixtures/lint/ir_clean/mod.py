@@ -1,0 +1,34 @@
+"""Clean twins of the doctored IR-tier fixture cases: same program
+shapes, written the way the policy wants them — no findings."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _host_norm(x):
+    return np.linalg.norm(np.asarray(x), axis=-1)
+
+
+@jax.jit
+def residency_clean(x):
+    return x * 2.0  # no host primitive: the program stays on-device
+
+
+@jax.jit
+def callback_clean(x):
+    # the same pure_callback, but its target is allowlisted by the test
+    return jax.pure_callback(
+        _host_norm, jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+
+@jax.jit
+def dtype_clean(a, b):
+    # bf16 operands, fp32 accumulation: the policy-conforming contraction
+    return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+
+@jax.jit
+def const_clean(x, big):
+    return x + big  # the weight-sized array arrives as an argument

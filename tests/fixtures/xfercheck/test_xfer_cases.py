@@ -1,0 +1,68 @@
+"""Doctored implicit-transfer cases for the DFT_XFERCHECK e2e tests.
+
+Driven by tests/test_xfercheck.py in a subprocess with DFT_XFERCHECK=1 +
+DFT_XFERCHECK_E2E=1: the seeded case feeds a raw numpy block straight
+into a jit dispatch inside a guarded section on a worker thread that
+SWALLOWS the raise (serving loops catch broadly by design) — only the
+conftest fixture's post-test check can fail it, which proves the real
+wiring. The explicit twin moves the same data the designed way
+(device_put feed, explicit() fetch scope) and must pass. The env guard
+keeps every normal tier from running them: without the driver variables
+they skip.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_faiss_tpu.utils import xfercheck
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("DFT_XFERCHECK_E2E") != "1",
+    reason="doctored case: driven by tests/test_xfercheck.py subprocess")
+
+
+def _double(x):
+    return x * 2.0
+
+
+def test_seeded_implicit_feed_fails_via_the_fixture():
+    """A numpy operand at jit dispatch inside guarded() is an implicit
+    host-to-device upload; the worker swallows the raise, the conftest
+    fixture must still fail this test."""
+    fn = jax.jit(_double)
+    q = np.ones((8, 4), np.float32)
+
+    def doctored_serve():
+        try:
+            with xfercheck.guarded("doctored merge-window flush"):
+                fn(q)  # implicit h2d: numpy straight into the dispatch
+        except xfercheck.ImplicitTransferError:
+            pass  # swallowed on purpose: the fixture must still fail us
+
+    t = threading.Thread(target=doctored_serve, name="doctored-server",
+                         daemon=True)
+    t.start()
+    t.join(30.0)
+
+
+def test_explicit_twin_is_clean():
+    """The same program with the designed moves: an explicit device_put
+    feed and an explicit() fetch scope — nothing to witness."""
+    fn = jax.jit(_double)
+    q = jax.device_put(np.ones((8, 4), np.float32))
+
+    def clean_serve():
+        with xfercheck.guarded("doctored merge-window flush"):
+            out = fn(q)  # device operand: no transfer at dispatch
+            with xfercheck.explicit("doctored result fetch"):
+                np.asarray(out)
+
+    t = threading.Thread(target=clean_serve, name="doctored-server",
+                         daemon=True)
+    t.start()
+    t.join(30.0)

@@ -1,0 +1,111 @@
+"""Runtime compile-count witness tests (marker ``compilecheck``).
+
+The DFT_COMPILECHECK=1 witness (utils/compilecheck.py) hooks jax's
+lowering logger at DEBUG and tallies ``Compiling <name> with global
+shapes`` records per entry. These tests pin the mechanics: install /
+uninstall idempotence with logger-level restore, the tally actually
+counting a fresh XLA compilation, cache hits counting nothing,
+snapshot/new_since window semantics, and the jit(...) name
+normalization the registry qualnames rely on. The serving-side budget
+assertion itself (zero new compiles after warmup under the 8-client
+mux storm) lives in tests/test_scheduler_identity.py.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_faiss_tpu.utils import compilecheck
+
+pytestmark = pytest.mark.compilecheck
+
+
+@pytest.fixture
+def tally():
+    """A clean installed tally, restored afterwards even when the
+    surrounding run (DFT_COMPILECHECK=1 tiers) already installed one."""
+    installed_here = not compilecheck._installed
+    compilecheck.install()
+    compilecheck.reset()
+    yield
+    compilecheck.reset()
+    if installed_here:
+        compilecheck.uninstall()
+
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("DFT_COMPILECHECK", raising=False)
+    assert not compilecheck.enabled()
+
+
+def test_install_is_idempotent_and_uninstall_restores_level():
+    logger = logging.getLogger(compilecheck._LOGGER_NAME)
+    if compilecheck._installed:  # an outer tier owns the hook: stand down
+        pytest.skip("compilecheck already installed by the surrounding run")
+    prev_level = logger.level
+    prev_handlers = list(logger.handlers)
+    compilecheck.install()
+    compilecheck.install()  # second install must not double-hook
+    assert len(compilecheck._installed) == 1
+    assert logger.level == logging.DEBUG
+    added = [h for h in logger.handlers if h not in prev_handlers]
+    assert len(added) == 1
+    compilecheck.uninstall()
+    assert not compilecheck._installed
+    assert logger.level == prev_level
+    assert logger.handlers == prev_handlers
+    compilecheck.uninstall()  # idempotent too
+
+
+def test_normalize_strips_jit_wrapper():
+    assert compilecheck._normalize("jit(_probe)") == "_probe"
+    assert compilecheck._normalize("_probe") == "_probe"
+
+
+def test_fresh_compile_is_tallied_and_cache_hits_are_not(tally):
+    def _tally_probe(x):
+        return x * 3.0 + 1.0
+
+    fn = jax.jit(_tally_probe)
+    fn(jax.device_put(np.ones((5, 7), np.float32)))  # fresh: compiles
+    counts = compilecheck.counts()
+    assert counts.get("_tally_probe", 0) >= 1
+    before = counts["_tally_probe"]
+    fn(jax.device_put(np.zeros((5, 7), np.float32)))  # cache hit
+    assert compilecheck.counts()["_tally_probe"] == before
+
+
+def test_snapshot_new_since_window_semantics(tally):
+    def _window_probe(x):
+        return x - 0.5
+
+    fn = jax.jit(_window_probe)
+    fn(jax.device_put(np.ones((3, 3), np.float32)))  # warmup compile
+    snap = compilecheck.snapshot()
+    fn(jax.device_put(np.full((3, 3), 2.0, np.float32)))  # same bucket
+    assert compilecheck.new_since(snap) == {}
+    fn(jax.device_put(np.ones((6, 3), np.float32)))  # new abstract shape
+    assert compilecheck.new_since(snap) == {"_window_probe": 1}
+
+
+def test_reset_clears_the_tally(tally):
+    def _reset_probe(x):
+        return x + 2.0
+
+    jax.jit(_reset_probe)(jax.device_put(np.ones((2,), np.float32)))
+    assert compilecheck.counts()
+    compilecheck.reset()
+    assert compilecheck.counts() == {}
+
+
+def test_hostile_log_records_never_raise(tally):
+    class _Hostile(logging.LogRecord):
+        def getMessage(self):
+            raise RuntimeError("malformed record")
+
+    handler = compilecheck._installed[0][1]
+    handler.emit(_Hostile("x", logging.DEBUG, "f", 1, "m", (), None))
+    assert compilecheck.counts() == {}  # swallowed, nothing tallied

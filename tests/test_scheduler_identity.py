@@ -17,7 +17,7 @@ import time
 import numpy as np
 import pytest
 
-from distributed_faiss_tpu.utils import racecheck
+from distributed_faiss_tpu.utils import compilecheck, racecheck
 
 from distributed_faiss_tpu import (
     IndexCfg,
@@ -384,6 +384,81 @@ def test_mesh_backed_clients_identical_and_one_launch_per_window(tmp_path):
     assert eng["rows_per_launch"]["max_s"] >= 4.0  # windows really merged rows
     for arm in setups:
         setups[arm][0].stop()
+
+
+@pytest.mark.mesh
+@pytest.mark.compilecheck
+def test_mesh_serving_compiles_nothing_after_warmup(tmp_path):
+    """Steady-state compile budget (graftlint 0.5 runtime witness): after
+    warming every pow2 query bucket an 8-client storm can reach (windows
+    merge 1..8 four-row requests -> 4..32 rows -> buckets 8/16/32), the
+    storm itself must compile ZERO new XLA programs — each retrace is a
+    multi-hundred-ms stall on the serving path, so a compile here means
+    the bucketing leaked a fresh abstract signature. The compile-count
+    witness (utils/compilecheck.py, DFT_COMPILECHECK) supplies the tally;
+    this test force-installs it so the budget is pinned in tier-1 too."""
+    x, meta, queries = build_corpus()
+    index_id = "mesh_budget"
+    mesh_cfg = IndexCfg(index_builder_type="flat", dim=16, metric="l2",
+                        train_num=64, mesh_shards=True)
+    installed_here = not compilecheck._installed
+    compilecheck.install()
+    try:
+        srv, port = start_server(tmp_path / "srv", "blocking",
+                                 SchedulerCfg(enabled=True, max_wait_ms=3.0))
+        disc = write_discovery(tmp_path, [port], "budget.txt")
+        admin = IndexClient(disc)
+        admin.create_index(index_id, mesh_cfg)
+        for s in range(0, x.shape[0], 100):
+            admin.add_index_data(index_id, x[s:s + 100], meta[s:s + 100])
+        admin.sync_train(index_id)
+        deadline = time.time() + 120
+        while (admin.get_state(index_id) != IndexState.TRAINED
+               or admin.get_buffer_depth(index_id) > 0):
+            assert time.time() < deadline, "mesh train/drain timed out"
+            time.sleep(0.1)
+
+        # warmup: touch every reachable query bucket through the real
+        # serving path (single client -> one window per request)
+        rng = np.random.default_rng(7)
+        for rows in (4, 8, 16, 32):
+            q = rng.standard_normal((rows, 16)).astype(np.float32)
+            admin.search(q, 3, index_id)
+        assert compilecheck.counts(), (
+            "compile witness saw no compilations at all — the "
+            "log_compiles hook is not wired")
+        snap = compilecheck.snapshot()
+
+        errors = []
+
+        def client_thread(tid):
+            try:
+                c = IndexClient(disc, None)
+                c.cfg = mesh_cfg
+                for _ in range(5):
+                    c.search(queries[tid], 3, index_id)
+                c.close()
+            except Exception as e:  # pragma: no cover
+                errors.append((tid, e))
+
+        ts = [threading.Thread(target=client_thread, args=(t,))
+              for t in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors, errors[:2]
+        fresh = compilecheck.new_since(snap)
+        assert not fresh, (
+            f"steady-state serving window compiled new XLA programs "
+            f"after warmup: {fresh}")
+        sched = srv.get_perf_stats()["scheduler"]["counters"]
+        assert sched["submitted"] >= 40  # the storm really went through
+        admin.close()
+        srv.stop()
+    finally:
+        if installed_here:
+            compilecheck.uninstall()
 
 
 @pytest.mark.slow

@@ -274,7 +274,11 @@ def test_versioned_add_replaces_older_live_row(tmp_path, rng):
         idx.add_batch(new_vec, [(5,)], train_async_if_triggered=False,
                       version=[v2])
         deadline = time.time() + 30
-        while idx.get_idx_data_num()[0] > 0:
+        # the drain worker flips ADD -> TRAINED only after the buffer
+        # count is already observable as 0: wait for BOTH (like
+        # wait_drained) or the search below races the state flip
+        while (idx.get_idx_data_num()[0] > 0
+               or idx.get_state() != IndexState.TRAINED):
             assert time.time() < deadline
             time.sleep(0.02)
         assert idx.mutation_stats()["version_replaced"] == 1
@@ -325,7 +329,10 @@ def test_refresh_pull_replaces_unversioned_live_row(tmp_path, rng):
         idx.add_batch(new_vec, [(4,)], train_async_if_triggered=False,
                       version=[v])  # the delta-pull shape
         deadline = time.time() + 30
-        while idx.get_idx_data_num()[0] > 0:
+        # buffer-empty alone races the drain worker's ADD -> TRAINED
+        # flip (see the companion test): wait for both
+        while (idx.get_idx_data_num()[0] > 0
+               or idx.get_state() != IndexState.TRAINED):
             assert time.time() < deadline
             time.sleep(0.02)
         sets = idx.id_sets()

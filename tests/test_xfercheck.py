@@ -1,0 +1,226 @@
+"""Runtime implicit-transfer witness tests (marker ``xfercheck``; the
+subprocess tier re-run is additionally ``slow``).
+
+Unit layer: the DFT_XFERCHECK=1 witness (utils/xfercheck.py) arms
+``jax.transfer_guard("disallow")`` around guarded() sections — a numpy
+operand at jit dispatch inside one raises ImplicitTransferError with
+label + thread + scope in the message and is recorded for the conftest
+check; explicit() re-allows a designed fetch/feed region; explicit-API
+moves (device_put) are fine under the guard; nested sections record the
+violation once; non-transfer exceptions pass through untouched;
+DFT_XFERCHECK_SCOPE picks the guarded directions.
+
+E2e layer: a subprocess pytest run over the doctored cases in
+tests/fixtures/xfercheck/ proves the REAL wiring — the autouse conftest
+fixture drains/checks around each test — fails a seeded implicit feed
+whose in-thread raise was SWALLOWED, and passes the explicit twin.
+
+Tier layer (``pytest -m xfercheck``, mirrored by the ci.yml
+``xfercheck`` job): re-run the scheduler, mesh-serving, and wire suites
+with DFT_XFERCHECK=1 + DFT_COMPILECHECK=1 — the dynamic complement of
+the IR tier's static device-residency rule, exactly as racecheck is to
+the shared-state checker.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_faiss_tpu.utils import xfercheck
+
+pytestmark = pytest.mark.xfercheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _double(x):
+    return x * 2.0
+
+
+@pytest.fixture
+def witness(monkeypatch):
+    """DFT_XFERCHECK=1 with the default (all) scope; recorded violations
+    are drained on the way out so a deliberate implicit transfer here
+    never leaks into another test's conftest check."""
+    monkeypatch.setenv("DFT_XFERCHECK", "1")
+    monkeypatch.delenv("DFT_XFERCHECK_SCOPE", raising=False)
+    yield
+    xfercheck.reset()
+
+
+# ------------------------------------------------------------------ switch
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("DFT_XFERCHECK", raising=False)
+    assert not xfercheck.enabled()
+    # guarded() is a transparent no-op: the implicit feed sails through
+    with xfercheck.guarded("off"):
+        assert not xfercheck.armed()
+        jax.jit(_double)(np.ones((4,), np.float32))
+    assert xfercheck.drain() == []
+
+
+def test_scope_default_and_validation(monkeypatch):
+    monkeypatch.delenv("DFT_XFERCHECK_SCOPE", raising=False)
+    assert xfercheck.scope() == "all"
+    monkeypatch.setenv("DFT_XFERCHECK_SCOPE", "d2h")
+    assert xfercheck.scope() == "d2h"
+    monkeypatch.setenv("DFT_XFERCHECK_SCOPE", "h2d")
+    assert xfercheck.scope() == "h2d"
+    monkeypatch.setenv("DFT_XFERCHECK_SCOPE", "bogus")
+    assert xfercheck.scope() == "all"
+
+
+# ------------------------------------------------------------- the witness
+
+def test_implicit_feed_raises_with_label_and_is_recorded(witness):
+    fn = jax.jit(_double)
+    with pytest.raises(xfercheck.ImplicitTransferError) as exc:
+        with xfercheck.guarded("unit merge-window flush"):
+            fn(np.ones((8, 4), np.float32))  # implicit h2d at dispatch
+    msg = str(exc.value)
+    assert "'unit merge-window flush'" in msg
+    assert "MainThread" in msg
+    assert "scope 'all'" in msg
+    leaks = xfercheck.drain()
+    assert len(leaks) == 1 and "unit merge-window flush" in leaks[0]
+
+
+def test_device_operand_and_device_put_are_clean(witness):
+    fn = jax.jit(_double)
+    with xfercheck.guarded("unit clean launch"):
+        assert xfercheck.armed()
+        x = jax.device_put(np.ones((8, 4), np.float32))  # explicit: allowed
+        fn(x)
+    assert not xfercheck.armed()
+    assert xfercheck.drain() == []
+
+
+def test_explicit_scope_allows_a_designed_feed(witness):
+    fn = jax.jit(_double)
+    with xfercheck.guarded("unit flush"):
+        with xfercheck.explicit("designed host feed"):
+            out = fn(np.ones((8, 4), np.float32))  # re-allowed inside
+            np.asarray(out)
+    assert xfercheck.drain() == []
+
+
+def test_explicit_is_a_noop_when_nothing_is_armed(monkeypatch):
+    monkeypatch.delenv("DFT_XFERCHECK", raising=False)
+    with xfercheck.explicit("cold path"):
+        pass  # no guard armed: must not even import-touch jax config
+
+
+def test_nested_guarded_records_exactly_once(witness):
+    fn = jax.jit(_double)
+    with pytest.raises(xfercheck.ImplicitTransferError):
+        with xfercheck.guarded("outer scheduler flush"):
+            with xfercheck.guarded("inner engine span"):
+                fn(np.ones((8, 4), np.float32))
+    leaks = xfercheck.drain()
+    assert len(leaks) == 1  # the innermost section converts; outer re-raises
+    assert "inner engine span" in leaks[0]
+    assert not xfercheck.armed()
+
+
+def test_non_transfer_exceptions_pass_through(witness):
+    with pytest.raises(ValueError, match="unrelated"):
+        with xfercheck.guarded("unit flush"):
+            raise ValueError("unrelated serving failure")
+    assert xfercheck.drain() == []
+
+
+def test_swallowed_raise_still_fails_check(witness):
+    fn = jax.jit(_double)
+
+    def serve():
+        try:
+            with xfercheck.guarded("swallowing serve loop"):
+                fn(np.ones((8, 4), np.float32))
+        except xfercheck.ImplicitTransferError:
+            pass  # the serving loop's broad except, in miniature
+
+    t = threading.Thread(target=serve, name="swallower", daemon=True)
+    t.start()
+    t.join(30.0)
+    assert not t.is_alive()
+    with pytest.raises(xfercheck.ImplicitTransferError,
+                       match="swallowing serve loop"):
+        xfercheck.check()
+    assert xfercheck.drain() == []  # check() drained
+
+
+def test_d2h_scope_leaves_host_feeds_unguarded(witness, monkeypatch):
+    """Scope plumbing: with only the device-to-host direction guarded,
+    the implicit h2d feed is out of scope and must not raise."""
+    monkeypatch.setenv("DFT_XFERCHECK_SCOPE", "d2h")
+    fn = jax.jit(_double)
+    with xfercheck.guarded("unit d2h-only flush"):
+        fn(np.ones((8, 4), np.float32))
+    assert xfercheck.drain() == []
+
+
+# ----------------------------------------------------------------------- e2e
+
+def _run_doctored(case: str):
+    env = dict(os.environ, DFT_XFERCHECK="1", DFT_XFERCHECK_E2E="1",
+               JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "pytest",
+         f"tests/fixtures/xfercheck/test_xfer_cases.py::{case}",
+         "-q", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+
+
+def test_e2e_conftest_fixture_fails_seeded_implicit_feed():
+    proc = _run_doctored("test_seeded_implicit_feed_fails_via_the_fixture")
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "ImplicitTransferError" in proc.stdout
+    assert "doctored merge-window flush" in proc.stdout
+
+
+def test_e2e_explicit_twin_passes():
+    proc = _run_doctored("test_explicit_twin_is_clean")
+    assert proc.returncode == 0, (
+        f"explicit twin failed under the witness:\n"
+        f"{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}")
+
+
+def test_e2e_cases_skip_without_driver_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DFT_XFERCHECK_E2E", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/fixtures/xfercheck/test_xfer_cases.py",
+         "-q", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 skipped" in proc.stdout
+
+
+# ------------------------------------------------------------------ the tier
+
+@pytest.mark.slow
+def test_serving_suites_under_witness():
+    """The xfercheck-tier satellite (mirrors the lockdep/threadcheck/
+    racecheck tiers): re-run the scheduler, mesh-serving, and wire fast
+    suites with BOTH runtime witnesses armed — every implicit transfer
+    on a hot path fails its test with provenance, and the compile tally
+    backs the steady-state budget assertions."""
+    env = dict(os.environ, DFT_XFERCHECK="1", DFT_COMPILECHECK="1",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_scheduler.py", "tests/test_scheduler_identity.py",
+         "tests/test_mesh_serving.py", "tests/test_wire.py",
+         "-q", "-m", "not slow", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=2400)
+    assert proc.returncode == 0, (
+        f"xfercheck tier failed:\n{proc.stdout[-6000:]}\n"
+        f"{proc.stderr[-2000:]}")

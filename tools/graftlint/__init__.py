@@ -14,6 +14,6 @@ hot-path/lock annotation conventions are documented in docs/LINTING.md.
 
 from tools.graftlint.core import Finding, lint_paths  # noqa: F401
 
-__version__ = "0.4.0"  # 0.4: whole-program shared-state race detector (thread-root model + Eraser-style lockset analysis, atomic() markers + rot audit) alongside the DFT_RACECHECK runtime lockset witness
+__version__ = "0.5.0"  # 0.5: IR tier — jit-entry registry (utils/jitreg.py) traced to ClosedJaxprs with device-residency / accumulation-dtype / const-capture / bucket-budget checks, plus the DFT_XFERCHECK transfer-guard and DFT_COMPILECHECK compile-count runtime witnesses
 
 DEFAULT_PATHS = ("distributed_faiss_tpu", "tools")

@@ -4,8 +4,16 @@
 files under the default paths — ``git diff --name-only HEAD`` — which is
 what scripts/precommit.sh runs so the growing checker suite stays fast
 at commit time. Cross-artifact rules that need the whole package (the
-PINS audit, the knob/doc drift check) gate themselves off on subsets;
-CI still runs the full lint.
+PINS audit, the knob/doc drift check) gate themselves off on subsets,
+and the IR rules stay dormant (known, but never audited stale) on any
+run without ``--ir``; CI still runs the full lint.
+
+``--ir`` adds the IR tier: trace every utils/jitreg.py registry entry to
+its ClosedJaxpr and run the equation-graph checkers, merged through the
+same suppression/rot-audit pipeline. ``--ir-only`` runs just that tier
+(suppressions still honored; the rot audit, undecidable without the AST
+checkers, stays off). Both need jax importable — the plain AST lint
+stays stdlib-only.
 
 Exit status: 0 when clean, 1 when findings, 2 on usage errors. Runs
 standalone (stdlib-only: ast) and under tier-1 via tests/test_graftlint.py
@@ -70,15 +78,49 @@ def main(argv=None) -> int:
         "--changed", action="store_true",
         help="lint only files touched vs git HEAD (plus untracked) under "
              "the default paths — the precommit fast path")
+    parser.add_argument(
+        "--ir", action="store_true",
+        help="also run the IR tier: trace the utils/jitreg.py registry "
+             "entries and check the equation graphs (needs jax)")
+    parser.add_argument(
+        "--ir-only", action="store_true",
+        help="run only the IR tier (suppressions honored, rot audit off)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule, mod in sorted(checks.RULES.items()):
             doc = (mod.__doc__ or "").strip().splitlines()[0]
             print(f"{rule}: {doc}")
+        from tools.graftlint.core import IR_RULES
+        from tools.graftlint import ir as ir_pkg
+        docs = {}
+        for ln in (ir_pkg.__doc__ or "").splitlines():
+            ln = ln.strip()
+            if ln.startswith("- ``ir-"):
+                rule = ln.split("``")[1]
+                docs[rule] = ln.split("—", 1)[-1].strip()
+        for rule in sorted(IR_RULES):
+            print(f"{rule}: [ir tier] {docs.get(rule, '')}")
         return 0
 
-    if args.changed:
+    ir_findings = None
+    if args.ir or args.ir_only:
+        try:
+            from tools.graftlint.ir import lint_ir
+        except ImportError as e:
+            print(f"graftlint: --ir needs jax importable: {e}",
+                  file=sys.stderr)
+            return 2
+        ir_findings = lint_ir()
+
+    if args.ir_only:
+        from tools.graftlint.core import build_model, lint
+        # subset model: the rot audit is undecidable without the AST
+        # checkers' findings, so it stays off — suppression matching for
+        # the IR findings still applies
+        model = build_model(args.paths, subset=True)
+        findings = lint(model, ir_findings=ir_findings, ast_checks=False)
+    elif args.changed:
         try:
             targets = changed_files()
         except RuntimeError as e:
@@ -90,9 +132,9 @@ def main(argv=None) -> int:
             return 0
         # subset lint: the rot audit and the knob/doc cross-check gate
         # themselves off (only decidable against the full package)
-        findings = lint_paths(targets, subset=True)
+        findings = lint_paths(targets, subset=True, ir_findings=ir_findings)
     else:
-        findings = lint_paths(args.paths)
+        findings = lint_paths(args.paths, ir_findings=ir_findings)
     if args.format == "json":
         print(json.dumps(
             {

@@ -48,6 +48,7 @@ from tools.graftlint.core import (
     dotted,
     lock_attrs,
     lock_context_events,
+    registry_launch_names,
 )
 
 RULE = "blocking-under-lock"
@@ -137,15 +138,21 @@ def _may_launch(model):
     launching = set()  # function ids
     callers = defaultdict(set)
     fns = {}
+    # the jit-entry registry's launch targets (utils/jitreg.py, parsed by
+    # core.registry_launch_names) are launch-semantic by declaration —
+    # unioned with the per-module jit scan so the registry, HOT_ROOTS and
+    # this checker can't drift apart on what "a launch" is
+    registry_names = registry_launch_names()
     for fi in model.functions:
         fns[id(fi)] = fi
-        if fi.jit is not None:
+        if fi.jit is not None or fi.name in registry_names:
             launching.add(id(fi))
         for sub in ast.walk(fi.node):
             if not isinstance(sub, ast.Call):
                 continue
             cn = call_name(sub)
             if cn in ("pallas_call", "pallas_guarded") or (
+                    cn in registry_names) or (
                     cn in model.jitted_names and cn not in HOT_EDGE_STOPLIST):
                 launching.add(id(fi))
             for name in _callee_names(sub):

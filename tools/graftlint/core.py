@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import functools
 import io
 import os
 import re
@@ -52,13 +53,61 @@ ATOMIC_RE = re.compile(r"#\s*graftlint:\s*atomic\(([^)]*)\)")
 # rooting them keeps the host-sync checker policing the multi-chip path
 # even where dynamic dispatch (scheduler callbacks, tpu_index attribute
 # calls) hides the edges from the name-based walk.
-HOT_ROOTS: Tuple[Tuple[str, str], ...] = (
-    ("engine.py", "Index.search"),
-    ("engine.py", "Index.search_batched"),
-    ("parallel/mesh.py", "ShardedFlatIndex.search"),
-    ("parallel/mesh.py", "ShardedIVFFlatIndex.search"),
-    ("parallel/mesh.py", "ShardedIVFPQIndex.search"),
-)
+#
+# The roots themselves live in utils/jitreg.py (the jit-entry registry):
+# the registry, this AST tier and the IR tier all describe the same
+# compiled-program surface, so there is exactly ONE declaration of it.
+# The registry file keeps its declarations as pure literals so this
+# stdlib-only tier can AST-parse it without importing jax.
+
+_JITREG_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir, os.pardir,
+    "distributed_faiss_tpu", "utils", "jitreg.py")
+
+# IR-tier rule names (tools/graftlint/ir). Declared here so the AST tier
+# can recognize ok(ir-*) suppressions as known — and hold them dormant
+# (not stale) on runs where the IR tier didn't execute.
+IR_RULES = frozenset({
+    "ir-device-residency", "ir-dtype", "ir-const-capture",
+    "ir-bucket-budget", "ir-trace-failure",
+})
+
+
+@functools.lru_cache(maxsize=1)
+def _registry_literals() -> Dict[str, object]:
+    """AST-parse utils/jitreg.py for its declarative literals."""
+    with open(_JITREG_PATH, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=_JITREG_PATH)
+    out: Dict[str, object] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in ("HOT_ROOTS", "REGISTRY",
+                                           "PURE_CALLBACK_ALLOWLIST")):
+            out[node.targets[0].id] = ast.literal_eval(node.value)
+    missing = {"HOT_ROOTS", "REGISTRY"} - set(out)
+    if missing:
+        raise RuntimeError(
+            f"utils/jitreg.py is missing literal declarations {sorted(missing)}"
+            " — the AST tier derives its hot-root/launch views from them")
+    return out
+
+
+def registry_rows() -> Tuple[dict, ...]:
+    """The jit-entry registry rows, as literals (no jax import)."""
+    return tuple(_registry_literals()["REGISTRY"])
+
+
+def registry_launch_names() -> frozenset:
+    """Qualnames of every registered jitted launch target — unioned into
+    the blocking checker's launch-name set so a registered kernel carries
+    launch semantics even where dynamic dispatch hides the jit decoration
+    from the per-module AST scan."""
+    return frozenset(r["qualname"] for r in registry_rows() if r.get("trace"))
+
+
+HOT_ROOTS: Tuple[Tuple[str, str], ...] = tuple(
+    (str(p), str(q)) for p, q in _registry_literals()["HOT_ROOTS"])
 
 # module aliases that resolve to code outside this repo: attribute calls
 # rooted here are never treated as calls to repo functions
@@ -869,7 +918,8 @@ SUPPRESSION_AUDIT_RULE = "unused-suppression"
 
 
 def _audit_suppressions(model: RepoModel, used: Dict[int, Set[int]],
-                        known_rules: Set[str]) -> List[Finding]:
+                        known_rules: Set[str],
+                        dormant_rules: Set[str] = frozenset()) -> List[Finding]:
     """The suppression-rot audit: every ``# graftlint: ok(<rule>)`` comment
     must either suppress a live finding THIS run or name a rule that no
     longer exists — a suppression that does neither is itself a finding,
@@ -886,6 +936,12 @@ def _audit_suppressions(model: RepoModel, used: Dict[int, Set[int]],
             if line in used_lines:
                 continue
             rules = mod.suppressions[line]
+            if rules & dormant_rules:
+                # names a rule belonging to a tier that did not run this
+                # invocation (the IR tier on an AST-only lint): whether the
+                # suppression is live is undecidable here, exactly like a
+                # subset lint — the tier's own full run audits it
+                continue
             if SUPPRESSION_AUDIT_RULE in rules:
                 # an opt-out marker is "used" exactly when it waives a
                 # dormant neighbor (recorded below). A PURE marker that
@@ -924,14 +980,25 @@ def _audit_suppressions(model: RepoModel, used: Dict[int, Set[int]],
     return out
 
 
-def lint(model: RepoModel) -> List[Finding]:
+def lint(model: RepoModel,
+         ir_findings: Optional[List[Finding]] = None,
+         ast_checks: bool = True) -> List[Finding]:
+    """Run the AST checkers (plus, when ``ir_findings`` is given, merge the
+    IR tier's pre-suppression findings) through the one suppression and
+    rot-audit pipeline. ``ir_findings=None`` means the IR tier did not run:
+    its rules stay *known* (a typo'd ok(ir-dtype) is still flagged) but
+    *dormant* for staleness — only a run that actually traced the registry
+    can decide whether an IR suppression is live. ``ast_checks=False``
+    (the ``--ir-only`` path) skips the AST checkers; pair it with a
+    subset model so the rot audit — undecidable without them — stays off."""
     from tools.graftlint import checks
 
     findings: List[Finding] = []
     by_path = {m.relpath: m for m in model.modules}
     used: Dict[int, Set[int]] = defaultdict(set)  # id(mod) -> comment lines
-    for checker in checks.ALL:
-        for f in checker.check(model):
+
+    def _consume(stream):
+        for f in stream:
             mod = by_path.get(f.path)
             if mod is not None:
                 sline = mod.match_suppression(f.rule, f.line)
@@ -939,21 +1006,31 @@ def lint(model: RepoModel) -> List[Finding]:
                     used[id(mod)].add(sline)
                     continue
             findings.append(f)
+
+    if ast_checks:
+        for checker in checks.ALL:
+            _consume(checker.check(model))
+    if ir_findings is not None:
+        _consume(ir_findings)
     if not model.subset:
         # the rot audit is only decidable against the full package: a
         # suppression whose finding resolves through modules OUTSIDE the
         # linted subset (a locked device launch into an unlinted jitted
         # callee, say) would look stale on every partial lint
-        known = set(checks.RULES) | {SUPPRESSION_AUDIT_RULE}
-        findings += _audit_suppressions(model, used, known)
+        known = set(checks.RULES) | {SUPPRESSION_AUDIT_RULE} | set(IR_RULES)
+        dormant = IR_RULES if ir_findings is None else frozenset()
+        findings += _audit_suppressions(model, used, known, dormant)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
-def lint_paths(paths: Iterable[str], subset: bool = False) -> List[Finding]:
+def lint_paths(paths: Iterable[str], subset: bool = False,
+               ir_findings: Optional[List[Finding]] = None) -> List[Finding]:
     """Lint ``paths``. ``subset=True`` marks a partial lint (the
     ``--changed`` precommit fast path): cross-artifact rules that are
     only decidable against the full package — the suppression-rot audit
     and env-knob-drift's doc cross-check — gate themselves off; CI's
-    full lint keeps them on."""
-    return lint(build_model(paths, subset=subset))
+    full lint keeps them on. ``ir_findings`` merges the IR tier's
+    pre-suppression findings (``tools.graftlint.ir.lint_ir()``) into the
+    same suppression/audit pipeline."""
+    return lint(build_model(paths, subset=subset), ir_findings=ir_findings)

@@ -1,0 +1,37 @@
+"""graftlint IR tier: jaxpr-level checks over the registered jit entries.
+
+This package's import is stdlib-only (the CLI must be able to report
+``--list-rules`` and recognize ``ok(ir-*)`` suppressions without jax);
+everything that traces programs lives in ``harness`` and is imported
+lazily by :func:`lint_ir`.
+
+Rules (names in ``tools.graftlint.core.IR_RULES``):
+
+- ``ir-device-residency`` — no callback/device_get-class primitive inside
+  a registered program; pure_callback only via the named allowlist.
+- ``ir-dtype`` — dot/conv-class equations over sub-fp32 operands must
+  accumulate in fp32 (int8-only contractions in int32/fp32): the below-AST
+  complement of the ``dtype-discipline`` rule.
+- ``ir-const-capture`` — no weight-sized array baked into a program as a
+  jaxpr const/literal (the silent-bloat recompile bomb).
+- ``ir-bucket-budget`` — each entry's reachable pow2 shape-bucket family
+  stays inside its declared budget, and the registry tracks the code
+  (an unregistered module-level jit def in a covered file, or a stale
+  registry row, is a finding).
+- ``ir-trace-failure`` — a registered entry that cannot be resolved and
+  abstract-evaled to a ClosedJaxpr (a trace failure is a finding, never a
+  skip: an untraceable entry is an unverified entry).
+"""
+
+from tools.graftlint.core import IR_RULES
+
+__all__ = ["IR_RULES", "lint_ir"]
+
+
+def lint_ir(entries=None, callback_allowlist=None):
+    """Trace the registry (or explicit ``entries`` rows) and run the IR
+    checkers. Returns a list of pre-suppression ``Finding``s. Imports jax."""
+    from tools.graftlint.ir import harness
+
+    return harness.lint_ir(entries=entries,
+                           callback_allowlist=callback_allowlist)
